@@ -1,0 +1,9 @@
+from dedloc_tpu.core.timeutils import get_dht_time, DHTExpiration, PerformanceEMA
+from dedloc_tpu.core.serialization import (
+    CompressionType,
+    serialize_array,
+    deserialize_array,
+    serialize_tree,
+    deserialize_tree,
+)
+from dedloc_tpu.core.config import Registry

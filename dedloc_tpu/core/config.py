@@ -1,0 +1,192 @@
+"""Typed configuration tree + component registry.
+
+The reference uses two config idioms — layered HfArgumentParser dataclasses
+(albert/arguments.py:7-128) and Hydra AttrDict composition (vissl) with
+string-keyed registries (register_optimizer / register_loss / ...). Per
+SURVEY.md §5 the TPU build unifies both into ONE idiom: plain dataclass trees
+(parseable from CLI) + a generic Registry.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field, fields, is_dataclass
+from typing import Any, Callable, Dict, List, Optional, Type, TypeVar, get_args, get_origin
+
+T = TypeVar("T")
+
+
+class Registry:
+    """String-keyed component registry (models, optimizers, losses, datasets).
+
+    Replaces vissl/ClassyVision's per-kind ``register_*`` decorators
+    (reference: classy_vision/optim/__init__.py:114-124 et al.).
+    """
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._entries: Dict[str, Any] = {}
+
+    def register(self, name: str) -> Callable[[T], T]:
+        def deco(obj: T) -> T:
+            if name in self._entries:
+                raise KeyError(f"{self.kind} {name!r} already registered")
+            self._entries[name] = obj
+            return obj
+
+        return deco
+
+    def get(self, name: str) -> Any:
+        if name not in self._entries:
+            raise KeyError(
+                f"unknown {self.kind} {name!r}; known: {sorted(self._entries)}"
+            )
+        return self._entries[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._entries
+
+    def names(self) -> List[str]:
+        return sorted(self._entries)
+
+
+MODELS = Registry("model")
+OPTIMIZERS = Registry("optimizer")
+LOSSES = Registry("loss")
+DATASETS = Registry("dataset")
+SCHEDULES = Registry("schedule")
+
+
+def _add_dataclass_args(parser: argparse.ArgumentParser, cls: Type, prefix: str = ""):
+    import typing
+
+    hints = typing.get_type_hints(cls)
+    for f in fields(cls):
+        ftype = hints.get(f.name, f.type)
+        if is_dataclass(ftype):
+            _add_dataclass_args(parser, ftype, prefix=f"{prefix}{f.name}.")
+            continue
+        name = f"--{prefix}{f.name}"
+        origin = get_origin(ftype)
+        if origin is Optional or (origin is type(None)):
+            ftype = get_args(ftype)[0]
+        elif origin is not None and type(None) in get_args(ftype):
+            ftype = next(a for a in get_args(ftype) if a is not type(None))
+        default = (
+            f.default
+            if f.default is not dataclasses.MISSING
+            else (f.default_factory() if f.default_factory is not dataclasses.MISSING else None)
+        )
+        if ftype is bool:
+            parser.add_argument(name, type=lambda s: s.lower() in ("1", "true", "yes"),
+                                default=default)
+        elif get_origin(ftype) in (list, List):
+            parser.add_argument(name, nargs="*", type=get_args(ftype)[0] if get_args(ftype) else str,
+                                default=default)
+        elif ftype in (int, float, str):
+            parser.add_argument(name, type=ftype, default=default)
+        else:
+            parser.add_argument(name, type=str, default=default)
+
+
+def parse_config(cls: Type[T], argv: Optional[List[str]] = None) -> T:
+    """Parse a (possibly nested) dataclass config from CLI flags.
+
+    Nested fields use dotted flags: ``--dht.initial_peers host:port``.
+    Replaces the reference's HfArgumentParser multi-dataclass pattern
+    (albert/run_trainer.py:211-212).
+    """
+    parser = argparse.ArgumentParser()
+    _add_dataclass_args(parser, cls)
+    ns = vars(parser.parse_args(argv))
+
+    import typing
+
+    def build(c: Type, prefix: str = ""):
+        hints = typing.get_type_hints(c)
+        kwargs = {}
+        for f in fields(c):
+            ftype = hints.get(f.name, f.type)
+            if is_dataclass(ftype):
+                kwargs[f.name] = build(ftype, prefix=f"{prefix}{f.name}.")
+            else:
+                kwargs[f.name] = ns[f"{prefix}{f.name}"]
+        return c(**kwargs)
+
+    return build(cls)
+
+
+# ---------------------------------------------------------------------------
+# The canonical argument tree, mirroring the reference's 3-layer flag system
+# (albert/arguments.py:7-101) with TPU-native additions.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DHTArguments:
+    """Reference: BaseTrainingArguments (albert/arguments.py:7-20)."""
+
+    experiment_prefix: str = "dedloc_tpu"
+    initial_peers: List[str] = field(default_factory=list)  # "host:port" strings
+    listen_host: str = "0.0.0.0"
+    listen_port: int = 0  # 0 = ephemeral
+    client_mode: bool = False  # outbound-only peer (albert/arguments.py:63-65)
+
+
+@dataclass
+class AveragerArguments:
+    """Reference: AveragerArguments (albert/arguments.py:22-54)."""
+
+    averaging_expiration: float = 5.0  # wait-for-stragglers window
+    averaging_timeout: float = 30.0  # hard abort for a round
+    min_refresh_period: float = 0.5
+    max_refresh_period: float = 30.0
+    default_refresh_period: float = 3.0
+    expected_drift_peers: float = 3.0
+    expected_drift_rate: float = 0.2
+    performance_ema_alpha: float = 0.1
+    target_group_size: int = 256
+    metadata_expiration: float = 30.0
+    compression: str = "float16"  # none | float16 | uint8
+    bandwidth: float = 1000.0  # advertised Mbps, for weighted partitioning
+
+
+@dataclass
+class CollaborativeOptimizerArguments:
+    """Reference: CollaborativeOptimizerArguments (albert/arguments.py:56-77)."""
+
+    target_batch_size: int = 4096
+    batch_size_lead: int = 0
+    statistics_expiration: float = 600.0
+
+
+@dataclass
+class TrainingArguments:
+    """Local-step recipe, mirroring AlbertTrainingArguments
+    (albert/arguments.py:104-128)."""
+
+    seq_length: int = 512
+    per_device_batch_size: int = 4
+    gradient_accumulation_steps: int = 2
+    learning_rate: float = 0.00176
+    warmup_steps: int = 5000
+    total_steps: int = 125_000
+    weight_decay: float = 0.01
+    max_grad_norm: float = 1.0
+    clamp_value: float = 10000.0
+    seed: int = 0
+    output_dir: str = "outputs"
+    save_steps: int = 500
+    save_total_limit: int = 2
+
+
+@dataclass
+class CollaborationArguments:
+    dht: DHTArguments = field(default_factory=DHTArguments)
+    averager: AveragerArguments = field(default_factory=AveragerArguments)
+    optimizer: CollaborativeOptimizerArguments = field(
+        default_factory=CollaborativeOptimizerArguments
+    )
+    training: TrainingArguments = field(default_factory=TrainingArguments)
+    wandb_project: Optional[str] = None
+    bandwidth: float = 1000.0

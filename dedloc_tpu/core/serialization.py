@@ -1,0 +1,97 @@
+"""Tensor wire (de)serialization with lossy compression.
+
+Capability parity with the reference's ``CompressionType.Value("FLOAT16")``
+wire format for averaging rounds (albert/arguments.py:75-77) plus a
+uint8 per-chunk affine quantizer for lower-bandwidth links. The framing is
+msgpack (self-describing, protobuf-free — see SURVEY.md §2.7).
+
+All encoders take/return numpy arrays: device arrays are fetched to host by
+the caller at the jit↔asyncio seam (SURVEY.md §7 hard-part b).
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, Tuple
+
+import msgpack
+import numpy as np
+
+
+class CompressionType(enum.Enum):
+    NONE = "none"
+    FLOAT16 = "float16"
+    UINT8 = "uint8"  # per-tensor affine quantization with fp32 scale/zero-point
+
+
+def _quantize_uint8(x: np.ndarray) -> Tuple[np.ndarray, float, float]:
+    lo = float(x.min()) if x.size else 0.0
+    hi = float(x.max()) if x.size else 0.0
+    scale = (hi - lo) / 255.0 or 1.0
+    q = np.clip(np.round((x - lo) / scale), 0, 255).astype(np.uint8)
+    return q, lo, scale
+
+
+def serialize_array(
+    x: np.ndarray, compression: CompressionType = CompressionType.NONE
+) -> bytes:
+    x = np.asarray(x)
+    header: Dict[str, Any] = {
+        "shape": list(x.shape),
+        "dtype": x.dtype.str,
+        "compression": compression.value,
+    }
+    if compression is CompressionType.NONE:
+        payload = np.ascontiguousarray(x).tobytes()
+    elif compression is CompressionType.FLOAT16:
+        payload = np.ascontiguousarray(x.astype(np.float16)).tobytes()
+    elif compression is CompressionType.UINT8:
+        q, lo, scale = _quantize_uint8(x.astype(np.float32))
+        header["lo"], header["scale"] = lo, scale
+        payload = q.tobytes()
+    else:  # pragma: no cover
+        raise ValueError(f"unknown compression {compression}")
+    return msgpack.packb({"h": header, "p": payload}, use_bin_type=True)
+
+
+def deserialize_array(data: bytes) -> np.ndarray:
+    obj = msgpack.unpackb(data, raw=False)
+    header, payload = obj["h"], obj["p"]
+    shape = tuple(header["shape"])
+    dtype = np.dtype(header["dtype"])
+    compression = CompressionType(header["compression"])
+    if compression is CompressionType.NONE:
+        return np.frombuffer(payload, dtype=dtype).reshape(shape).copy()
+    if compression is CompressionType.FLOAT16:
+        return (
+            np.frombuffer(payload, dtype=np.float16).reshape(shape).astype(dtype)
+        )
+    if compression is CompressionType.UINT8:
+        q = np.frombuffer(payload, dtype=np.uint8).reshape(shape)
+        x = q.astype(np.float32) * header["scale"] + header["lo"]
+        return x.astype(dtype)
+    raise ValueError(f"unknown compression {compression}")  # pragma: no cover
+
+
+def serialize_tree(
+    tree: Dict[str, np.ndarray],
+    compression: CompressionType = CompressionType.NONE,
+) -> bytes:
+    """Serialize a flat {name: array} mapping (e.g. flattened params/grads)."""
+    return msgpack.packb(
+        {k: serialize_array(v, compression) for k, v in tree.items()},
+        use_bin_type=True,
+    )
+
+
+def deserialize_tree(data: bytes) -> Dict[str, np.ndarray]:
+    obj = msgpack.unpackb(data, raw=False)
+    return {k: deserialize_array(v) for k, v in obj.items()}
+
+
+def pack_obj(obj: Any) -> bytes:
+    """msgpack helper for small control-plane objects (DHT values, metadata)."""
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack_obj(data: bytes) -> Any:
+    return msgpack.unpackb(data, raw=False)

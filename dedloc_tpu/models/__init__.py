@@ -1,0 +1,5 @@
+from dedloc_tpu.models.albert import (
+    AlbertConfig,
+    AlbertForPreTraining,
+    albert_pretraining_loss,
+)

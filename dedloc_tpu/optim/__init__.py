@@ -1,0 +1,6 @@
+from dedloc_tpu.optim.lamb import lamb, albert_weight_decay_mask
+from dedloc_tpu.optim.lars import lars
+from dedloc_tpu.optim.schedules import (
+    linear_warmup_linear_decay,
+    linear_warmup_cosine_annealing,
+)

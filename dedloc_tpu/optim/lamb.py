@@ -1,0 +1,156 @@
+"""LAMB optimizer (layer-wise adaptive moments) in optax style.
+
+Capability parity with the reference recipe (albert/run_trainer.py:73-100):
+torch_optimizer.Lamb(lr=..., betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
+clamp_value=10000, debias=True) with weight decay excluded for bias and
+LayerNorm parameters. Implemented as composable optax gradient transforms so
+the whole update runs inside the jitted train step (no host round-trip).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import chex
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class ScaleByLambState(NamedTuple):
+    count: chex.Array
+    mu: optax.Updates
+    nu: optax.Updates
+
+
+def scale_by_lamb(
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+    clamp_value: float = 10000.0,
+    debias: bool = True,
+) -> optax.GradientTransformation:
+    """Adam moments + layer-wise trust ratio with weight-norm clamp.
+
+    The trust ratio is ``min(||w||, clamp_value) / ||adam_update||``, matching
+    torch_optimizer.Lamb's ``clamp_value`` semantics.
+    """
+
+    def init_fn(params):
+        mu = jax.tree.map(jnp.zeros_like, params)
+        nu = jax.tree.map(jnp.zeros_like, params)
+        return ScaleByLambState(count=jnp.zeros([], jnp.int32), mu=mu, nu=nu)
+
+    def update_fn(updates, state, params):
+        assert params is not None, "lamb requires params"
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, updates)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, state.nu, updates)
+        count = state.count + 1
+        if debias:
+            mu_hat = jax.tree.map(lambda m: m / (1 - b1 ** count.astype(jnp.float32)), mu)
+            nu_hat = jax.tree.map(lambda v: v / (1 - b2 ** count.astype(jnp.float32)), nu)
+        else:
+            mu_hat, nu_hat = mu, nu
+
+        adam_step = jax.tree.map(
+            lambda m, v: m / (jnp.sqrt(v) + eps), mu_hat, nu_hat
+        )
+
+        def trust_ratio(w, u):
+            w_norm = jnp.minimum(jnp.linalg.norm(w.astype(jnp.float32)), clamp_value)
+            u_norm = jnp.linalg.norm(u.astype(jnp.float32))
+            ratio = jnp.where(
+                (w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0
+            )
+            return u * ratio
+
+        updates = jax.tree.map(trust_ratio, params, adam_step)
+        return updates, ScaleByLambState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def albert_weight_decay_mask(params) -> Any:
+    """True where weight decay applies: everything except biases and
+    LayerNorm/embedding-LN scale/bias (reference: run_trainer.py:78-87
+    no_decay = ["bias", "LayerNorm.weight"])."""
+
+    def decide(path, _):
+        names = [p.key for p in path if hasattr(p, "key")]
+        joined = "/".join(names).lower()
+        if names and names[-1] == "bias":
+            return False
+        if "layernorm" in joined or "layer_norm" in joined:
+            return False
+        return True
+
+    return jax.tree_util.tree_map_with_path(decide, params)
+
+
+def lamb(
+    learning_rate: optax.ScalarOrSchedule,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    clamp_value: float = 10000.0,
+    debias: bool = True,
+    weight_decay_mask: Optional[Callable] = albert_weight_decay_mask,
+    max_grad_norm: Optional[float] = None,
+) -> optax.GradientTransformation:
+    """Full LAMB chain: [clip] -> moments+decay -> trust ratio -> lr.
+
+    Weight decay is added to the adam update BEFORE the trust ratio (the
+    torch_optimizer.Lamb formulation the reference trains with).
+    """
+    # Decay must enter before the trust-ratio scaling, so we fold it into the
+    # update inside a custom wrapper around scale_by_lamb.
+    inner = scale_by_lamb(b1, b2, eps, clamp_value, debias)
+
+    def init_fn(params):
+        return inner.init(params)
+
+    def update_fn(updates, state, params):
+        # adam moments (without trust ratio) computed by inner on (grads);
+        # we re-implement the ordering here: moments -> +wd*param -> trust.
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, updates)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, state.nu, updates)
+        count = state.count + 1
+        c = count.astype(jnp.float32)
+        if debias:
+            mu_hat = jax.tree.map(lambda m: m / (1 - b1 ** c), mu)
+            nu_hat = jax.tree.map(lambda v: v / (1 - b2 ** c), nu)
+        else:
+            mu_hat, nu_hat = mu, nu
+        adam_step = jax.tree.map(lambda m, v: m / (jnp.sqrt(v) + eps), mu_hat, nu_hat)
+
+        if weight_decay > 0.0:
+            mask = (
+                weight_decay_mask(params)
+                if callable(weight_decay_mask)
+                else jax.tree.map(lambda _: True, params)
+            )
+            adam_step = jax.tree.map(
+                lambda u, w, m: u + weight_decay * w if m else u,
+                adam_step,
+                params,
+                mask,
+                is_leaf=lambda x: x is None,
+            )
+
+        def trust_ratio(w, u):
+            w_norm = jnp.minimum(jnp.linalg.norm(w.astype(jnp.float32)), clamp_value)
+            u_norm = jnp.linalg.norm(u.astype(jnp.float32))
+            ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+            return u * ratio
+
+        updates = jax.tree.map(trust_ratio, params, adam_step)
+        new_state = ScaleByLambState(count=count, mu=mu, nu=nu)
+        return updates, new_state
+
+    chain = [optax.GradientTransformation(init_fn, update_fn)]
+    if max_grad_norm is not None:
+        chain.insert(0, optax.clip_by_global_norm(max_grad_norm))
+    chain.append(
+        optax.scale_by_learning_rate(learning_rate)  # negates for descent
+    )
+    return optax.chain(*chain)

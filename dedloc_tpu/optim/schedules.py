@@ -1,0 +1,50 @@
+"""LR schedules driven by the GLOBAL collaboration step.
+
+The reference hands LR control to CollaborativeOptimizer's internal scheduler
+(NoOpScheduler shim at albert/run_trainer.py:189-207; get_linear_schedule_with
+_warmup at :95-100; LinearWarmupCosineAnnealingLR at
+sgd_collaborative.py:25-84). Here schedules are pure functions of the global
+optimizer step, evaluated inside the jitted update.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def linear_warmup_linear_decay(
+    peak_lr: float, warmup_steps: int, total_steps: int
+) -> optax.Schedule:
+    """transformers.get_linear_schedule_with_warmup equivalent."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = step / jnp.maximum(1.0, warmup_steps)
+        decay = jnp.maximum(
+            0.0, (total_steps - step) / jnp.maximum(1.0, total_steps - warmup_steps)
+        )
+        return peak_lr * jnp.where(step < warmup_steps, warm, decay)
+
+    return schedule
+
+
+def linear_warmup_cosine_annealing(
+    peak_lr: float,
+    warmup_steps: int,
+    total_steps: int,
+    warmup_start_lr: float = 0.0,
+    eta_min: float = 0.0,
+) -> optax.Schedule:
+    """LinearWarmupCosineAnnealingLR equivalent (sgd_collaborative.py:25-84)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = warmup_start_lr + (peak_lr - warmup_start_lr) * step / jnp.maximum(
+            1.0, warmup_steps
+        )
+        progress = (step - warmup_steps) / jnp.maximum(1.0, total_steps - warmup_steps)
+        progress = jnp.clip(progress, 0.0, 1.0)
+        cos = eta_min + (peak_lr - eta_min) * 0.5 * (1 + jnp.cos(jnp.pi * progress))
+        return jnp.where(step < warmup_steps, warm, cos)
+
+    return schedule

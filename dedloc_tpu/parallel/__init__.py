@@ -1,0 +1,8 @@
+from dedloc_tpu.parallel.mesh import make_mesh, shard_batch, replicate
+from dedloc_tpu.parallel.train_step import (
+    TrainState,
+    make_accumulate_step,
+    make_apply_step,
+    make_local_train_step,
+    params_are_finite,
+)

@@ -1,0 +1,46 @@
+"""Device-mesh construction and sharding helpers.
+
+The TPU-native replacement for the reference's two communication worlds
+(SURVEY.md §1/§2.6): a pod slice is ONE logical collaborative peer; gradient
+averaging inside the slice is the psum XLA inserts for the sharded-batch mean
+over ICI; the asyncio averager only ever runs BETWEEN slices.
+
+Axes:
+  data  — pure data parallelism (the only parallelism the reference has)
+  model — reserved for tensor-parallel shardings of large models (free via
+          pjit; not required for capability parity, see SURVEY.md §2.5)
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Sequence[str] = ("data",),
+    shape: Optional[Sequence[int]] = None,
+) -> Mesh:
+    devices = jax.devices()[: n_devices or len(jax.devices())]
+    if shape is None:
+        shape = [len(devices)] + [1] * (len(axis_names) - 1)
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, axis_names)
+
+
+def shard_batch(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Sharding for a batch pytree: leading axis split over the data axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def put_batch(batch, mesh: Mesh, axis: str = "data"):
+    """Device-put a host batch with the batch axis sharded over `axis`."""
+    sharding = shard_batch(mesh, axis)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)

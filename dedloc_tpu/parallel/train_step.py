@@ -1,0 +1,163 @@
+"""pjit train-step builders: local accumulation vs global apply.
+
+The collaborative loop (SURVEY.md §3.1) splits one "step" into two phases with
+different cadences, so we compile them separately:
+
+  accumulate — per micro-batch: forward/backward under jit, grads summed into
+               a persistent accumulator (donated). Sharded batch ⇒ the grad
+               mean rides an ICI psum inserted by XLA. Runs constantly.
+  apply      — once per GLOBAL optimizer step, on (possibly peer-averaged)
+               gradients: optimizer update + LR schedule by global step.
+
+``make_local_train_step`` fuses both (scan over micro-batches) for the
+single-peer / CI path — capability of the plain HF Trainer loop with
+gradient_accumulation_steps (albert/arguments.py:109).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import chex
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class TrainState(struct.PyTreeNode):
+    """Model + optimizer state keyed by the GLOBAL collaboration step.
+
+    ``step`` mirrors ``collaboration_state.optimizer_step`` in the reference
+    (consumed by the swav loss at standard_train_step.py:153).
+    """
+
+    step: chex.Array
+    params: Any
+    opt_state: Any
+
+    @classmethod
+    def create(cls, params, tx: optax.GradientTransformation) -> "TrainState":
+        return cls(
+            step=jnp.zeros([], jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+        )
+
+
+LossFn = Callable[..., Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]]
+
+
+def zeros_like_grads(params):
+    return jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+
+
+def make_accumulate_step(
+    loss_fn: LossFn,
+    mesh: Optional[Mesh] = None,
+) -> Callable:
+    """Build jitted (params, grad_acc, n_acc, batch, rng) -> (grad_acc', n_acc', metrics).
+
+    grad_acc holds the running SUM of per-micro-batch mean gradients; n_acc
+    counts micro-batches so the caller can normalize before averaging/apply.
+    The accumulator is donated: it lives in device memory across calls, so the
+    host<->device traffic per micro-batch is just the batch itself.
+    """
+
+    def step(params, grad_acc, n_acc, batch, rng):
+        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch, rng
+        )
+        grad_acc = jax.tree.map(
+            lambda a, g: a + g.astype(jnp.float32), grad_acc, grads
+        )
+        return grad_acc, n_acc + 1, metrics
+
+    kwargs = dict(donate_argnums=(1, 2))
+    if mesh is not None:
+        repl = NamedSharding(mesh, P())
+        data = NamedSharding(mesh, P("data"))
+        kwargs.update(
+            in_shardings=(repl, repl, repl, data, repl),
+            out_shardings=(repl, repl, repl),
+        )
+    return jax.jit(step, **kwargs)
+
+
+def make_apply_step(
+    tx: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+) -> Callable:
+    """Build jitted (state, mean_grads) -> state'. Runs once per global step."""
+
+    def apply(state: TrainState, grads) -> TrainState:
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state
+        )
+
+    kwargs = dict(donate_argnums=(0,))
+    if mesh is not None:
+        repl = NamedSharding(mesh, P())
+        kwargs.update(in_shardings=(repl, repl), out_shardings=repl)
+    return jax.jit(apply, **kwargs)
+
+
+def make_local_train_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    grad_accum_steps: int = 1,
+    mesh: Optional[Mesh] = None,
+) -> Callable:
+    """Single-peer fused step: scan over micro-batches, then optimizer apply.
+
+    batch leaves must have shape [grad_accum_steps, per_step_batch, ...].
+    """
+
+    def train_step(state: TrainState, batch, rng):
+        def micro(carry, mb):
+            grad_acc, r = carry
+            r, sub = jax.random.split(r)
+            (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, mb, sub
+            )
+            grad_acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32) / grad_accum_steps,
+                grad_acc,
+                grads,
+            )
+            return (grad_acc, r), metrics
+
+        (grads, _), metrics = jax.lax.scan(
+            micro, (zeros_like_grads(state.params), rng), batch
+        )
+        metrics = jax.tree.map(lambda m: m.mean(), metrics)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state
+        )
+        return new_state, metrics
+
+    kwargs = dict(donate_argnums=(0,))
+    if mesh is not None:
+        repl = NamedSharding(mesh, P())
+        data = NamedSharding(mesh, P(None, "data"))
+        kwargs.update(
+            in_shardings=(repl, data, repl), out_shardings=(repl, repl)
+        )
+    return jax.jit(train_step, **kwargs)
+
+
+@jax.jit
+def params_are_finite(params) -> jnp.ndarray:
+    """All-finite check over a pytree (reference: CollaborativeCallback.
+    params_are_finite, albert/run_trainer.py:181-186). Used by the NaN-guard
+    rollback in the collaborative wrapper."""
+    leaves = jax.tree.leaves(params)
+    finite = jnp.array(True)
+    for leaf in leaves:
+        finite &= jnp.all(jnp.isfinite(leaf))
+    return finite

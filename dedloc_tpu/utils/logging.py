@@ -1,0 +1,24 @@
+"""Logging helpers (reference: hivemind.utils.logging.get_logger usage and
+rank-0-only verbosity, albert/run_trainer.py:36-53)."""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "[%(asctime)s.%(msecs)03d][%(levelname)s][%(name)s] %(message)s"
+_configured = False
+
+
+def get_logger(name: str = "dedloc_tpu") -> logging.Logger:
+    global _configured
+    if not _configured:
+        level = os.environ.get("DEDLOC_LOGLEVEL", "INFO").upper()
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%Y-%m-%d %H:%M:%S"))
+        root = logging.getLogger("dedloc_tpu")
+        root.addHandler(handler)
+        root.setLevel(level)
+        root.propagate = False
+        _configured = True
+    return logging.getLogger(name)

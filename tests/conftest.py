@@ -1,0 +1,28 @@
+"""Test env: force an 8-device virtual CPU mesh BEFORE jax import.
+
+This is how multi-chip shardings are validated without hardware
+(SURVEY.md environment notes): XLA's CPU backend executes the same
+sharded programs + collectives the TPU path compiles to.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# The container's sitecustomize registers a TPU platform and overrides
+# jax_platforms via jax.config — the env var alone is not enough.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,99 @@
+import numpy as np
+import pytest
+
+from dedloc_tpu.core.serialization import (
+    CompressionType,
+    deserialize_array,
+    deserialize_tree,
+    serialize_array,
+    serialize_tree,
+)
+from dedloc_tpu.core.timeutils import PerformanceEMA, ValueWithExpiration, get_dht_time
+from dedloc_tpu.core.config import (
+    CollaborationArguments,
+    Registry,
+    parse_config,
+)
+
+
+def test_serialize_roundtrip_none(rng):
+    x = rng.standard_normal((17, 5)).astype(np.float32)
+    y = deserialize_array(serialize_array(x, CompressionType.NONE))
+    np.testing.assert_array_equal(x, y)
+
+
+def test_serialize_roundtrip_float16(rng):
+    x = rng.standard_normal((64,)).astype(np.float32)
+    y = deserialize_array(serialize_array(x, CompressionType.FLOAT16))
+    np.testing.assert_allclose(x, y, atol=1e-2, rtol=1e-2)
+    assert y.dtype == np.float32
+
+
+def test_serialize_roundtrip_uint8(rng):
+    x = rng.standard_normal((1000,)).astype(np.float32)
+    y = deserialize_array(serialize_array(x, CompressionType.UINT8))
+    span = x.max() - x.min()
+    assert np.abs(x - y).max() <= span / 255.0 + 1e-6
+
+
+def test_serialize_tree(rng):
+    tree = {"a": rng.standard_normal((3, 3)).astype(np.float32), "b": np.arange(5)}
+    out = deserialize_tree(serialize_tree(tree))
+    assert set(out) == {"a", "b"}
+    np.testing.assert_array_equal(out["b"], tree["b"])
+
+
+def test_performance_ema():
+    ema = PerformanceEMA(alpha=0.5)
+    ema.update(10)
+    first = ema.samples_per_second
+    assert first > 0
+    ema.pause()
+    ema.update(10)  # should not change while paused
+    assert ema.samples_per_second == first
+    ema.resume()
+    ema.update(10)
+    assert ema.samples_per_second > 0
+
+
+def test_value_with_expiration():
+    v = ValueWithExpiration("x", get_dht_time() + 100)
+    assert not v.expired()
+    v2 = ValueWithExpiration("x", get_dht_time() - 1)
+    assert v2.expired()
+
+
+def test_registry():
+    r = Registry("thing")
+
+    @r.register("foo")
+    def foo():
+        return 42
+
+    assert r.get("foo")() == 42
+    assert "foo" in r
+    with pytest.raises(KeyError):
+        r.get("bar")
+    with pytest.raises(KeyError):
+        r.register("foo")(foo)
+
+
+def test_parse_config_defaults():
+    cfg = parse_config(CollaborationArguments, argv=[])
+    assert cfg.optimizer.target_batch_size == 4096
+    assert cfg.averager.target_group_size == 256
+    assert cfg.training.seq_length == 512
+
+
+def test_parse_config_overrides():
+    cfg = parse_config(
+        CollaborationArguments,
+        argv=[
+            "--optimizer.target_batch_size", "128",
+            "--dht.initial_peers", "a:1", "b:2",
+            "--dht.client_mode", "true",
+        ],
+    )
+    assert cfg.optimizer.target_batch_size == 128
+    assert cfg.dht.initial_peers == ["a:1", "b:2"]
+    assert cfg.dht.client_mode is True

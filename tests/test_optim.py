@@ -1,0 +1,100 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dedloc_tpu.optim import (
+    lamb,
+    lars,
+    albert_weight_decay_mask,
+    linear_warmup_linear_decay,
+    linear_warmup_cosine_annealing,
+)
+
+
+def _rosenbrock_params():
+    return {"w": jnp.array([1.5, 1.5]), "bias": jnp.array([0.5])}
+
+
+def test_lamb_minimizes_quadratic():
+    params = {"dense": {"kernel": jnp.array([[2.0, -3.0]]), "bias": jnp.array([1.0])}}
+    target = {"dense": {"kernel": jnp.array([[0.5, 0.5]]), "bias": jnp.array([0.0])}}
+
+    def loss(p):
+        return sum(
+            jnp.sum((a - b) ** 2)
+            for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(target))
+        )
+
+    tx = lamb(1e-1, weight_decay=0.0)
+    state = tx.init(params)
+
+    @jax.jit
+    def step(p, s):
+        g = jax.grad(loss)(p)
+        u, s = tx.update(g, s, p)
+        import optax
+
+        return optax.apply_updates(p, u), s
+
+    l0 = float(loss(params))
+    for _ in range(100):
+        params, state = step(params, state)
+    assert float(loss(params)) < l0 * 1e-2
+
+
+def test_lamb_weight_decay_mask():
+    params = {
+        "encoder": {
+            "layernorm": {"scale": jnp.ones(3), "bias": jnp.zeros(3)},
+            "ffn": {"kernel": jnp.ones((3, 3)), "bias": jnp.zeros(3)},
+        }
+    }
+    mask = albert_weight_decay_mask(params)
+    assert mask["encoder"]["ffn"]["kernel"] is True
+    assert mask["encoder"]["ffn"]["bias"] is False
+    assert mask["encoder"]["layernorm"]["scale"] is False
+    assert mask["encoder"]["layernorm"]["bias"] is False
+
+
+def test_lamb_trust_ratio_clamp():
+    """Huge params: ||w|| must be clamped at clamp_value in the trust ratio."""
+    params = {"w": jnp.full((10,), 1e6)}
+    tx = lamb(1.0, weight_decay=0.0, clamp_value=10.0)
+    state = tx.init(params)
+    g = {"w": jnp.ones((10,))}
+    u, _ = tx.update(g, state, params)
+    # trust ratio = min(||w||, 10)/||step||; adam step ~= sign ⇒ ||step||~sqrt(10)
+    assert float(jnp.linalg.norm(u["w"])) <= 10.0 + 1e-3
+
+
+def test_lars_minimizes_quadratic():
+    params = {"kernel": jnp.array([3.0, -2.0])}
+
+    def loss(p):
+        return jnp.sum(p["kernel"] ** 2)
+
+    tx = lars(0.5, momentum=0.9, weight_decay=0.0, trust_coefficient=0.01)
+    state = tx.init(params)
+    import optax
+
+    l0 = float(loss(params))
+    for _ in range(200):
+        g = jax.grad(loss)(params)
+        u, state = tx.update(g, state, params)
+        params = optax.apply_updates(params, u)
+    assert float(loss(params)) < l0 * 1e-2
+
+
+def test_linear_schedule():
+    s = linear_warmup_linear_decay(1.0, warmup_steps=10, total_steps=110)
+    assert float(s(0)) == 0.0
+    assert abs(float(s(10)) - 1.0) < 1e-6
+    assert abs(float(s(60)) - 0.5) < 1e-6
+    assert float(s(110)) == 0.0
+
+
+def test_cosine_schedule():
+    s = linear_warmup_cosine_annealing(1.0, warmup_steps=10, total_steps=110)
+    assert float(s(0)) == 0.0
+    assert abs(float(s(10)) - 1.0) < 1e-2
+    assert float(s(110)) < 1e-6
