@@ -1,0 +1,10 @@
+from dedloc_tpu.dht.node import DHTNode
+from dedloc_tpu.dht.dht import DHT
+from dedloc_tpu.dht.crypto import RSAPrivateKey
+from dedloc_tpu.dht.validation import (
+    RecordValidatorBase,
+    RSASignatureValidator,
+    SchemaValidator,
+    CompositeValidator,
+    DHTRecord,
+)

@@ -1,0 +1,51 @@
+"""RSA keys for signed DHT records.
+
+Capability parity with hivemind.dht.crypto's RSASignatureValidator keys used
+at albert/metrics_utils.py:21-24 and the local_public_key the trainers seed
+their shuffling with (albert/run_trainer.py:266-270).
+"""
+from __future__ import annotations
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+_PADDING = padding.PSS(
+    mgf=padding.MGF1(hashes.SHA256()), salt_length=padding.PSS.DIGEST_LENGTH
+)
+
+
+class RSAPrivateKey:
+    def __init__(self, key: rsa.RSAPrivateKey | None = None):
+        self._key = key or rsa.generate_private_key(
+            public_exponent=65537, key_size=2048
+        )
+
+    def sign(self, data: bytes) -> bytes:
+        return self._key.sign(data, _PADDING, hashes.SHA256())
+
+    def public_bytes(self) -> bytes:
+        return self._key.public_key().public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+
+    def to_bytes(self) -> bytes:
+        return self._key.private_bytes(
+            serialization.Encoding.DER,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "RSAPrivateKey":
+        return cls(serialization.load_der_private_key(data, password=None))
+
+
+def verify_signature(public_key_bytes: bytes, data: bytes, signature: bytes) -> bool:
+    try:
+        pub = serialization.load_der_public_key(public_key_bytes)
+        pub.verify(signature, data, _PADDING, hashes.SHA256())
+        return True
+    except (InvalidSignature, ValueError, TypeError):
+        return False

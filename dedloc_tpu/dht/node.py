@@ -1,0 +1,339 @@
+"""Asyncio Kademlia DHT node: iterative routing, replicated records,
+validator-gated stores.
+
+In-tree replacement for hivemind.DHT's node (SURVEY.md §2.6). One node = one
+asyncio endpoint; N nodes can share one process and event loop, which is how
+multi-peer behavior is tested without a cluster (closing the reference's
+biggest test gap, SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from dedloc_tpu.core.timeutils import DHTExpiration, ValueWithExpiration, get_dht_time
+from dedloc_tpu.dht.protocol import Endpoint, RPCClient, RPCServer
+from dedloc_tpu.dht.routing import DHTID, NodeInfo, RoutingTable
+from dedloc_tpu.dht.storage import DHTLocalStorage, DictionaryDHTValue
+from dedloc_tpu.dht.validation import CompositeValidator, DHTRecord, RecordValidatorBase
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _pack_nodes(nodes: Sequence[NodeInfo]) -> List[List[Any]]:
+    return [[n.node_id.to_bytes(), n.endpoint[0], n.endpoint[1]] for n in nodes]
+
+
+def _unpack_nodes(raw: Sequence[Sequence[Any]]) -> List[NodeInfo]:
+    return [
+        NodeInfo(DHTID.from_bytes(r[0]), (r[1], int(r[2]))) for r in raw
+    ]
+
+
+class DHTNode:
+    """A single DHT peer. Use ``await DHTNode.create(...)``."""
+
+    def __init__(self):
+        raise RuntimeError("use DHTNode.create(...)")
+
+    @classmethod
+    async def create(
+        cls,
+        listen_host: str = "0.0.0.0",
+        listen_port: int = 0,
+        initial_peers: Sequence[Endpoint] = (),
+        node_id: Optional[DHTID] = None,
+        bucket_size: int = 20,
+        num_replicas: int = 5,
+        parallel_rpc: int = 3,
+        request_timeout: float = 5.0,
+        record_validators: Sequence[RecordValidatorBase] = (),
+        client_mode: bool = False,
+        advertised_host: Optional[str] = None,
+    ) -> "DHTNode":
+        self = object.__new__(cls)
+        self.node_id = node_id or DHTID.generate()
+        self.bucket_size = bucket_size
+        self.num_replicas = num_replicas
+        self.parallel_rpc = parallel_rpc
+        self.request_timeout = request_timeout
+        self.client_mode = client_mode
+        self.routing_table = RoutingTable(self.node_id, bucket_size)
+        self.storage = DHTLocalStorage()
+        self.cache = DHTLocalStorage(maxsize=2000)
+        self.validator = CompositeValidator(record_validators)
+        self.client = RPCClient(request_timeout=request_timeout)
+        self.server: Optional[RPCServer] = None
+        self.port: Optional[int] = None
+        self.advertised_host = advertised_host or "127.0.0.1"
+        if not client_mode:
+            self.server = RPCServer(listen_host, listen_port)
+            for method in ("dht.ping", "dht.find", "dht.store"):
+                self.server.register(method, getattr(self, "_rpc_" + method.split(".")[1]))
+            await self.server.start()
+            self.port = self.server.port
+        if initial_peers:
+            await self.bootstrap(initial_peers)
+        return self
+
+    @property
+    def endpoint(self) -> Endpoint:
+        return (self.advertised_host, self.port or 0)
+
+    # ------------------------------------------------------------------ RPCs
+
+    def _sender_args(self) -> Dict[str, Any]:
+        return {
+            "sender_id": self.node_id.to_bytes(),
+            "sender_port": self.port,  # None in client mode
+        }
+
+    def _register_sender(self, peer: Endpoint, args: Dict[str, Any]) -> None:
+        port = args.get("sender_port")
+        sid = args.get("sender_id")
+        if port and sid:
+            self.routing_table.add_or_update_node(
+                NodeInfo(DHTID.from_bytes(sid), (peer[0], int(port)))
+            )
+
+    async def _rpc_ping(self, peer: Endpoint, args: Dict[str, Any]) -> Dict[str, Any]:
+        self._register_sender(peer, args)
+        return {"node_id": self.node_id.to_bytes(), "dht_time": get_dht_time()}
+
+    async def _rpc_find(self, peer: Endpoint, args: Dict[str, Any]) -> Dict[str, Any]:
+        """find_node + find_value in one RPC (hivemind-style)."""
+        self._register_sender(peer, args)
+        target = DHTID.from_bytes(args["target"])
+        nearest = _pack_nodes(
+            self.routing_table.nearest_neighbors(target, self.bucket_size)
+        )
+        result: Dict[str, Any] = {"nodes": nearest}
+        if args.get("return_value"):
+            key = args["key"]
+            entry = self.storage.get(key) or self.cache.get(key)
+            if entry is not None:
+                value, expiration = entry
+                if isinstance(value, DictionaryDHTValue):
+                    result["dict_value"] = [
+                        [sk, v.value, v.expiration_time] for sk, v in value.items()
+                    ]
+                else:
+                    result["value"] = value
+                result["expiration"] = expiration
+        return result
+
+    async def _rpc_store(self, peer: Endpoint, args: Dict[str, Any]) -> Dict[str, Any]:
+        self._register_sender(peer, args)
+        outcomes = []
+        for rec in args["records"]:
+            key, subkey, value, expiration = rec
+            record = DHTRecord(key, subkey, value, expiration)
+            if not self.validator.validate(record):
+                outcomes.append(False)
+                continue
+            if subkey is not None:
+                outcomes.append(self.storage.store(key, value, expiration, subkey=subkey))
+            else:
+                outcomes.append(self.storage.store(key, value, expiration))
+        return {"stored": outcomes}
+
+    # ----------------------------------------------------------- client side
+
+    async def bootstrap(self, initial_peers: Sequence[Endpoint]) -> None:
+        pings = await asyncio.gather(
+            *(self._ping(tuple(p)) for p in initial_peers), return_exceptions=True
+        )
+        if not any(p is True for p in pings):
+            logger.warning(f"bootstrap: no initial peer of {len(list(initial_peers))} responded")
+        await self.find_nearest_nodes(self.node_id)
+
+    async def _ping(self, endpoint: Endpoint) -> bool:
+        try:
+            result = await self.client.call(
+                endpoint, "dht.ping", self._sender_args()
+            )
+            self.routing_table.add_or_update_node(
+                NodeInfo(DHTID.from_bytes(result["node_id"]), tuple(endpoint))
+            )
+            return True
+        except Exception:  # noqa: BLE001 — peer unreachable
+            return False
+
+    async def find_nearest_nodes(
+        self, target: DHTID, k: Optional[int] = None
+    ) -> List[NodeInfo]:
+        """Iterative Kademlia lookup over the `dht.find` RPC."""
+        k = k or self.bucket_size
+        candidates: Dict[int, NodeInfo] = {
+            n.node_id: n for n in self.routing_table.nearest_neighbors(target, k)
+        }
+        queried: set = set()
+        while True:
+            frontier = sorted(
+                (n for nid, n in candidates.items() if nid not in queried),
+                key=lambda n: n.node_id ^ target,
+            )[: self.parallel_rpc]
+            if not frontier:
+                break
+            best_known = sorted(candidates, key=lambda nid: nid ^ target)[:k]
+            if best_known and all(nid in queried for nid in best_known):
+                break
+            replies = await asyncio.gather(
+                *(
+                    self.client.call(
+                        n.endpoint,
+                        "dht.find",
+                        {**self._sender_args(), "target": target.to_bytes()},
+                    )
+                    for n in frontier
+                ),
+                return_exceptions=True,
+            )
+            for node, reply in zip(frontier, replies):
+                queried.add(node.node_id)
+                if isinstance(reply, Exception):
+                    self.routing_table.remove_node(node.node_id)
+                    candidates.pop(node.node_id, None)
+                    continue
+                for info in _unpack_nodes(reply["nodes"]):
+                    if info.node_id != self.node_id:
+                        candidates.setdefault(info.node_id, info)
+                        self.routing_table.add_or_update_node(info)
+        out = sorted(candidates.values(), key=lambda n: n.node_id ^ target)
+        return out[:k]
+
+    async def store(
+        self,
+        key: bytes,
+        value: bytes,
+        expiration_time: DHTExpiration,
+        subkey: Optional[bytes] = None,
+    ) -> bool:
+        """Sign, validate locally, then replicate onto the nearest peers."""
+        key_id = DHTID.of_key(key)
+        record = DHTRecord(key, subkey, value, expiration_time)
+        signed = self.validator.sign_value(record)
+        record = DHTRecord(key, subkey, signed, expiration_time)
+        if not self.validator.validate(record):
+            # e.g. relaying a record owned (signed) by a key we don't hold
+            logger.debug(f"record for key {key!r} failed local validation")
+            return False
+
+        nearest = await self.find_nearest_nodes(key_id, k=self.num_replicas)
+        stored_anywhere = False
+        # self-store if we are closer than the furthest replica (or low pop.)
+        if not self.client_mode and (
+            len(nearest) < self.num_replicas
+            or (self.node_id ^ key_id) < (nearest[-1].node_id ^ key_id)
+        ):
+            if subkey is not None:
+                stored_anywhere |= self.storage.store(
+                    key, signed, expiration_time, subkey=subkey
+                )
+            else:
+                stored_anywhere |= self.storage.store(key, signed, expiration_time)
+        wire_record = [key, subkey, signed, expiration_time]
+        replies = await asyncio.gather(
+            *(
+                self.client.call(
+                    n.endpoint,
+                    "dht.store",
+                    {**self._sender_args(), "records": [wire_record]},
+                )
+                for n in nearest
+            ),
+            return_exceptions=True,
+        )
+        for reply in replies:
+            if not isinstance(reply, Exception) and any(reply.get("stored", [])):
+                stored_anywhere = True
+        return stored_anywhere
+
+    async def get(
+        self, key: bytes, latest: bool = False
+    ) -> Optional[ValueWithExpiration]:
+        """Fetch a record; ``latest=True`` always queries the network and
+        merges dictionary subkeys across replicas."""
+        key_id = DHTID.of_key(key)
+        local = (None if self.client_mode else self.storage.get(key)) or self.cache.get(key)
+        if local is not None and not latest:
+            return self._strip(key, local)
+
+        merged_dict = DictionaryDHTValue()
+        best_value: Optional[ValueWithExpiration] = None
+        if local is not None:
+            if isinstance(local.value, DictionaryDHTValue):
+                for sk, v in local.value.items():
+                    merged_dict.store(sk, v.value, v.expiration_time)
+            else:
+                best_value = local
+
+        nearest = await self.find_nearest_nodes(key_id, k=self.num_replicas)
+        replies = await asyncio.gather(
+            *(
+                self.client.call(
+                    n.endpoint,
+                    "dht.find",
+                    {
+                        **self._sender_args(),
+                        "target": key_id.to_bytes(),
+                        "key": key,
+                        "return_value": True,
+                    },
+                )
+                for n in nearest
+            ),
+            return_exceptions=True,
+        )
+        for reply in replies:
+            if isinstance(reply, Exception):
+                continue
+            # validate on the READ path too: a malicious replica could serve
+            # forged records it never accepted through _rpc_store
+            if "dict_value" in reply:
+                for sk, v, exp in reply["dict_value"]:
+                    if self.validator.validate(DHTRecord(key, sk, v, exp)):
+                        merged_dict.store(sk, v, exp)
+            elif "value" in reply:
+                candidate = ValueWithExpiration(reply["value"], reply["expiration"])
+                if not self.validator.validate(
+                    DHTRecord(key, None, candidate.value, candidate.expiration_time)
+                ):
+                    continue
+                if best_value is None or candidate.expiration_time > best_value.expiration_time:
+                    best_value = candidate
+
+        now = get_dht_time()
+        if len(merged_dict):
+            result = ValueWithExpiration(
+                merged_dict, merged_dict.latest_expiration_time
+            )
+            if result.expiration_time > now:
+                for sk, v in merged_dict.items():
+                    self.cache.store(key, v.value, v.expiration_time, subkey=sk)
+                return self._strip(key, result)
+        if best_value is not None and best_value.expiration_time > now:
+            self.cache.store(key, best_value.value, best_value.expiration_time)
+            return self._strip(key, best_value)
+        return None
+
+    def _strip(self, key: bytes, entry: ValueWithExpiration) -> ValueWithExpiration:
+        """Remove signature wrapping for the reader."""
+        if isinstance(entry.value, DictionaryDHTValue):
+            out = DictionaryDHTValue()
+            for sk, v in entry.value.items():
+                stripped = self.validator.strip_value(
+                    DHTRecord(key, sk, v.value, v.expiration_time)
+                )
+                out.store(sk, stripped, v.expiration_time)
+            return ValueWithExpiration(out, entry.expiration_time)
+        stripped = self.validator.strip_value(
+            DHTRecord(key, None, entry.value, entry.expiration_time)
+        )
+        return ValueWithExpiration(stripped, entry.expiration_time)
+
+    async def shutdown(self) -> None:
+        await self.client.close()
+        if self.server is not None:
+            await self.server.stop()

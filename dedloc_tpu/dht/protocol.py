@@ -1,0 +1,189 @@
+"""asyncio TCP transport + msgpack RPC framing for the DHT and averager.
+
+This is the in-tree replacement for the reference's transport dependencies
+(libp2p daemon + gRPC, SURVEY.md §2.7): length-prefixed msgpack frames over
+TCP with a small request/response RPC layer. NAT traversal and relays are
+descoped for datacenter TPU fleets, but the seam is this module — a future
+transport only needs to provide ``call`` and ``serve``.
+"""
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+from dedloc_tpu.core.serialization import pack_obj, unpack_obj
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+Endpoint = Tuple[str, int]
+MAX_FRAME = 512 * 1024 * 1024  # tensors ride this transport too
+_LEN = struct.Struct("!I")
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    payload = await reader.readexactly(length)
+    return unpack_obj(payload)
+
+
+def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
+    payload = pack_obj(obj)
+    writer.write(_LEN.pack(len(payload)))
+    writer.write(payload)
+
+
+Handler = Callable[[Endpoint, Dict[str, Any]], Awaitable[Any]]
+
+
+class RPCServer:
+    """Serves named RPC methods; one task per connection, many requests per
+    connection (pipelined)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self.host, self.requested_port = host, port
+        self._handlers: Dict[str, Handler] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._writers: set = set()
+        self.port: Optional[int] = None
+
+    def register(self, method: str, handler: Handler) -> None:
+        self._handlers[method] = handler
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self.requested_port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # force-close live connections: in py3.12 wait_closed() waits for
+            # all handlers, which would otherwise hang on idle peers
+            for writer in list(self._writers):
+                writer.close()
+            await self._server.wait_closed()
+
+    async def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername") or ("?", 0)
+        self._writers.add(writer)
+        try:
+            while True:
+                try:
+                    msg = await read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+                    return
+                asyncio.ensure_future(self._dispatch(peer, msg, writer))
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    async def _dispatch(self, peer, msg, writer) -> None:
+        req_id = msg.get("id")
+        method = msg.get("method")
+        handler = self._handlers.get(method)
+        try:
+            if handler is None:
+                raise KeyError(f"unknown method {method!r}")
+            result = await handler(tuple(peer[:2]), msg.get("args") or {})
+            reply = {"id": req_id, "ok": True, "result": result}
+        except Exception as e:  # noqa: BLE001 — RPC boundary
+            logger.debug(f"rpc {method} failed: {e!r}")
+            reply = {"id": req_id, "ok": False, "error": repr(e)}
+        try:
+            write_frame(writer, reply)
+            await writer.drain()
+        except (ConnectionResetError, RuntimeError, BrokenPipeError):
+            pass
+
+
+class RPCClient:
+    """Pooled msgpack-RPC client: one persistent connection per endpoint."""
+
+    def __init__(self, request_timeout: float = 5.0):
+        self.request_timeout = request_timeout
+        self._conns: Dict[Endpoint, Tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
+        self._pending: Dict[Endpoint, Dict[int, asyncio.Future]] = {}
+        self._readers: Dict[Endpoint, asyncio.Task] = {}
+        self._next_id = 0
+        self._conn_locks: Dict[Endpoint, asyncio.Lock] = {}
+
+    async def _connect(self, endpoint: Endpoint):
+        lock = self._conn_locks.setdefault(endpoint, asyncio.Lock())
+        async with lock:
+            if endpoint in self._conns:
+                return self._conns[endpoint]
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(*endpoint), timeout=self.request_timeout
+            )
+            self._conns[endpoint] = (reader, writer)
+            self._pending[endpoint] = {}
+            self._readers[endpoint] = asyncio.ensure_future(
+                self._read_loop(endpoint, reader)
+            )
+            return reader, writer
+
+    async def _read_loop(self, endpoint: Endpoint, reader: asyncio.StreamReader):
+        try:
+            while True:
+                msg = await read_frame(reader)
+                fut = self._pending.get(endpoint, {}).pop(msg.get("id"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result(msg)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self._drop(endpoint, ConnectionResetError("connection lost"))
+
+    def _drop(self, endpoint: Endpoint, exc: Exception) -> None:
+        conn = self._conns.pop(endpoint, None)
+        if conn is not None:
+            conn[1].close()
+        task = self._readers.pop(endpoint, None)
+        if task is not None:
+            task.cancel()
+        for fut in self._pending.pop(endpoint, {}).values():
+            if not fut.done():
+                fut.set_exception(exc)
+
+    async def call(
+        self,
+        endpoint: Endpoint,
+        method: str,
+        args: Optional[Dict[str, Any]] = None,
+        timeout: Optional[float] = None,
+    ) -> Any:
+        """Invoke a remote method; raises on transport error / remote error."""
+        endpoint = (endpoint[0], int(endpoint[1]))
+        _, writer = await self._connect(endpoint)
+        self._next_id += 1
+        req_id = self._next_id
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[endpoint][req_id] = fut
+        write_frame(writer, {"id": req_id, "method": method, "args": args or {}})
+        try:
+            await writer.drain()
+            reply = await asyncio.wait_for(
+                fut, timeout=timeout or self.request_timeout
+            )
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            self._pending.get(endpoint, {}).pop(req_id, None)
+            raise
+        if not reply.get("ok"):
+            raise RPCError(reply.get("error", "unknown remote error"))
+        return reply.get("result")
+
+    async def close(self) -> None:
+        for endpoint in list(self._conns):
+            self._drop(endpoint, ConnectionResetError("client closed"))
+
+
+class RPCError(Exception):
+    pass

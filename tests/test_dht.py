@@ -1,0 +1,337 @@
+"""Multi-peer DHT tests: N asyncio nodes in one process (SURVEY.md §4 —
+the in-process simulation layer the reference never had)."""
+import asyncio
+
+import pytest
+
+from dedloc_tpu.core.serialization import pack_obj, unpack_obj
+from dedloc_tpu.core.timeutils import get_dht_time
+from dedloc_tpu.dht.node import DHTNode
+from dedloc_tpu.dht.routing import DHTID, NodeInfo, RoutingTable
+from dedloc_tpu.dht.storage import DHTLocalStorage, DictionaryDHTValue
+from dedloc_tpu.dht.validation import (
+    DHTRecord,
+    RSASignatureValidator,
+    SchemaValidator,
+    CompositeValidator,
+)
+
+
+# ----------------------------------------------------------- routing + store
+
+
+def test_dhtid_distance():
+    a, b = DHTID.of_key("x"), DHTID.of_key("y")
+    assert a.xor_distance(a) == 0
+    assert a.xor_distance(b) == b.xor_distance(a)
+    assert DHTID.from_bytes(a.to_bytes()) == a
+
+
+def test_routing_table_basics():
+    me = DHTID.generate()
+    table = RoutingTable(me, bucket_size=4)
+    infos = [NodeInfo(DHTID.generate(), ("127.0.0.1", 1000 + i)) for i in range(50)]
+    for info in infos:
+        table.add_or_update_node(info)
+    assert len(table) > 0
+    target = DHTID.generate()
+    nearest = table.nearest_neighbors(target, k=5)
+    assert len(nearest) == 5
+    dists = [n.node_id ^ target for n in nearest]
+    assert dists == sorted(dists)
+
+
+def test_local_storage_expiration():
+    store = DHTLocalStorage()
+    now = get_dht_time()
+    assert store.store(b"k", b"v", now + 10)
+    assert store.get(b"k").value == b"v"
+    # older expiration loses
+    assert not store.store(b"k", b"v2", now + 5)
+    assert store.get(b"k").value == b"v"
+    # newer wins
+    assert store.store(b"k", b"v3", now + 20)
+    assert store.get(b"k").value == b"v3"
+    # expired records are not stored
+    assert not store.store(b"dead", b"v", now - 1)
+    assert store.get(b"dead") is None
+
+
+def test_local_storage_subkeys():
+    store = DHTLocalStorage()
+    now = get_dht_time()
+    assert store.store(b"m", b"a1", now + 10, subkey=b"peer_a")
+    assert store.store(b"m", b"b1", now + 20, subkey=b"peer_b")
+    entry = store.get(b"m")
+    assert isinstance(entry.value, DictionaryDHTValue)
+    assert len(entry.value) == 2
+    assert entry.expiration_time == pytest.approx(now + 20, abs=0.1)
+    # per-subkey newer-wins
+    assert not store.store(b"m", b"a0", now + 5, subkey=b"peer_a")
+    assert store.store(b"m", b"a2", now + 30, subkey=b"peer_a")
+
+
+# ----------------------------------------------------------------- validators
+
+
+def test_rsa_signature_validator():
+    alice, bob = RSASignatureValidator(), RSASignatureValidator()
+    now = get_dht_time()
+    rec = DHTRecord(b"metrics", alice.local_public_key, b"payload", now + 10)
+    signed = alice.sign_value(rec)
+    assert signed != b"payload"
+    signed_rec = DHTRecord(rec.key, rec.subkey, signed, rec.expiration_time)
+    assert alice.validate(signed_rec)
+    assert bob.validate(signed_rec)  # anyone can verify
+    assert bob.strip_value(signed_rec) == b"payload"
+    # forgery: bob cannot sign under alice's subkey
+    forged = bob.sign_value(rec)  # refuses to sign, returns raw value
+    assert not bob.validate(DHTRecord(rec.key, rec.subkey, forged, rec.expiration_time))
+    # tamper: flip the payload
+    tampered = DHTRecord(rec.key, rec.subkey, signed + b"x", rec.expiration_time)
+    assert not alice.validate(tampered)
+    # unowned subkeys pass through
+    plain = DHTRecord(b"metrics", b"not_a_key", b"v", now + 10)
+    assert alice.validate(plain)
+
+
+def test_schema_validator():
+    import pydantic
+
+    class Metrics(pydantic.BaseModel):
+        step: int
+        loss: float
+
+    v = SchemaValidator({"metrics": Metrics}, prefix="exp")
+    now = get_dht_time()
+    good = DHTRecord(b"exp_metrics", None, pack_obj({"step": 1, "loss": 2.5}), now + 5)
+    bad = DHTRecord(b"exp_metrics", None, pack_obj({"step": "NaN?"}), now + 5)
+    other = DHTRecord(b"unrelated", None, b"anything", now + 5)
+    assert v.validate(good)
+    assert not v.validate(bad)
+    assert v.validate(other)  # allow_extra_keys
+
+
+def test_composite_schema_over_signature():
+    """Schema must validate the UNWRAPPED value of signed records."""
+    import pydantic
+
+    class Metrics(pydantic.BaseModel):
+        step: int
+
+    sig = RSASignatureValidator()
+    validator = CompositeValidator(
+        [SchemaValidator({"metrics": Metrics}, prefix="exp"), sig]
+    )
+    now = get_dht_time()
+    rec = DHTRecord(
+        b"exp_metrics", sig.local_public_key, pack_obj({"step": 3}), now + 5
+    )
+    signed = validator.sign_value(rec)
+    wire = DHTRecord(rec.key, rec.subkey, signed, rec.expiration_time)
+    assert validator.validate(wire)
+    assert unpack_obj(validator.strip_value(wire)) == {"step": 3}
+
+
+# ------------------------------------------------------------- network nodes
+
+
+async def _make_swarm(n, **kwargs):
+    first = await DHTNode.create(listen_host="127.0.0.1", **kwargs)
+    rest = [
+        await DHTNode.create(
+            listen_host="127.0.0.1", initial_peers=[first.endpoint], **kwargs
+        )
+        for _ in range(n - 1)
+    ]
+    return [first] + rest
+
+
+async def _shutdown(nodes):
+    await asyncio.gather(*(n.shutdown() for n in nodes))
+
+
+def test_store_get_across_nodes():
+    async def run():
+        nodes = await _make_swarm(5)
+        try:
+            now = get_dht_time()
+            ok = await nodes[1].store(b"greeting", b"hello", now + 30)
+            assert ok
+            for reader in (nodes[0], nodes[3], nodes[4]):
+                entry = await reader.get(b"greeting", latest=True)
+                assert entry is not None and entry.value == b"hello"
+        finally:
+            await _shutdown(nodes)
+
+    asyncio.run(run())
+
+
+def test_subkey_merge_across_writers():
+    """Many peers write their own subkey to one key; readers see all
+    (the {prefix}_metrics pattern, albert/run_first_peer.py:177-200)."""
+
+    async def run():
+        nodes = await _make_swarm(4)
+        try:
+            now = get_dht_time()
+            for i, node in enumerate(nodes):
+                ok = await node.store(
+                    b"metrics", pack_obj({"peer": i}), now + 30,
+                    subkey=b"peer%d" % i,
+                )
+                assert ok
+            entry = await nodes[0].get(b"metrics", latest=True)
+            assert entry is not None
+            seen = {sk for sk, _ in entry.value.items()}
+            assert seen == {b"peer0", b"peer1", b"peer2", b"peer3"}
+        finally:
+            await _shutdown(nodes)
+
+    asyncio.run(run())
+
+
+def test_expired_records_vanish():
+    async def run():
+        nodes = await _make_swarm(3)
+        try:
+            now = get_dht_time()
+            await nodes[0].store(b"shortlived", b"x", now + 0.5)
+            entry = await nodes[1].get(b"shortlived", latest=True)
+            assert entry is not None
+            await asyncio.sleep(0.8)
+            entry = await nodes[1].get(b"shortlived", latest=True)
+            assert entry is None
+        finally:
+            await _shutdown(nodes)
+
+    asyncio.run(run())
+
+
+def test_node_failure_tolerated():
+    async def run():
+        nodes = await _make_swarm(5)
+        try:
+            now = get_dht_time()
+            await nodes[1].store(b"durable", b"v", now + 30)
+            # kill two nodes, data must still resolve via replicas
+            await nodes[2].shutdown()
+            await nodes[3].shutdown()
+            entry = await nodes[4].get(b"durable", latest=True)
+            assert entry is not None and entry.value == b"v"
+        finally:
+            await _shutdown([nodes[0], nodes[1], nodes[4]])
+
+    asyncio.run(run())
+
+
+def test_validated_swarm_rejects_forgeries():
+    async def run():
+        honest_v = RSASignatureValidator()
+        mallory_v = RSASignatureValidator()
+        nodes = await _make_swarm(3, record_validators=[RSASignatureValidator()])
+        try:
+            now = get_dht_time()
+            # honest: signs under own subkey — accepted
+            rec = DHTRecord(b"metrics", honest_v.local_public_key,
+                            pack_obj({"loss": 1.0}), now + 30)
+            signed = honest_v.sign_value(rec)
+            ok = await nodes[0].store(b"metrics", signed, now + 30,
+                                      subkey=honest_v.local_public_key)
+            assert ok
+            # mallory: tries to write under honest's subkey — rejected
+            forged = mallory_v.sign_value(rec)  # can't actually sign
+            ok = await nodes[1].store(b"metrics", forged, now + 40,
+                                      subkey=honest_v.local_public_key)
+            assert not ok
+        finally:
+            await _shutdown(nodes)
+
+    asyncio.run(run())
+
+
+def test_read_path_rejects_forged_replica_data():
+    """A malicious replica serving forged records must not poison readers:
+    validation runs on the READ path, not just at store time."""
+
+    async def run():
+        nodes = await _make_swarm(3, record_validators=[RSASignatureValidator()])
+        victim_v = RSASignatureValidator()
+        try:
+            now = get_dht_time()
+            # poison one node's local storage directly (bypassing _rpc_store,
+            # as a compromised peer would)
+            forged = pack_obj({"loss": 0.0})
+            for node in nodes[1:]:  # poison the REMOTE replicas only
+                node.storage.store(
+                    b"metrics", forged, now + 60, subkey=victim_v.local_public_key
+                )
+            entry = await nodes[0].get(b"metrics", latest=True)
+            # forged unsigned entries under an owned subkey are dropped
+            assert entry is None or len(entry.value) == 0 or all(
+                not sk.startswith(b"rsa:") for sk, _ in entry.value.items()
+            )
+        finally:
+            await _shutdown(nodes)
+
+    asyncio.run(run())
+
+
+def test_dht_shutdown_idempotent():
+    from dedloc_tpu.dht import DHT
+
+    d = DHT(start=True, listen_host="127.0.0.1")
+    d.shutdown()
+    d.shutdown()  # must not raise
+
+
+def test_client_mode_node():
+    """client_mode peers make outbound calls only (albert/arguments.py:63-65)."""
+
+    async def run():
+        server_nodes = await _make_swarm(3)
+        client = await DHTNode.create(
+            initial_peers=[server_nodes[0].endpoint], client_mode=True
+        )
+        try:
+            assert client.port is None
+            now = get_dht_time()
+            ok = await client.store(b"from_client", b"hi", now + 30)
+            assert ok
+            entry = await server_nodes[2].get(b"from_client", latest=True)
+            assert entry is not None and entry.value == b"hi"
+        finally:
+            await _shutdown(server_nodes + [client])
+
+    asyncio.run(run())
+
+
+# ----------------------------------------------------------------- facade
+
+
+def test_dht_facade_threaded():
+    from dedloc_tpu.dht import DHT
+
+    first = DHT(start=True, listen_host="127.0.0.1")
+    second = DHT(
+        start=True,
+        listen_host="127.0.0.1",
+        initial_peers=[first.get_visible_address()],
+    )
+    try:
+        now = get_dht_time()
+        assert first.port and second.port and first.port != second.port
+        second.store("facade_key", {"x": [1, 2, 3]}, now + 30)
+        entry = first.get("facade_key", latest=True)
+        assert entry is not None and entry.value == {"x": [1, 2, 3]}
+        # subkey dict via facade
+        second.store("facade_dict", 7, now + 30, subkey=b"a")
+        first.store("facade_dict", 8, now + 30, subkey=b"b")
+        entry = second.get("facade_dict", latest=True)
+        assert {sk: v.value for sk, v in entry.value.items()} == {b"a": 7, b"b": 8}
+        # future-based API
+        fut = first.get("facade_key", latest=True, return_future=True)
+        assert fut.result().value == {"x": [1, 2, 3]}
+    finally:
+        second.shutdown()
+        first.shutdown()
