@@ -1,0 +1,4 @@
+from dedloc_tpu.averaging.partition import partition_weighted, flatten_tree, unflatten_tree
+from dedloc_tpu.averaging.allreduce import GroupAllReduce, AllreduceFailed
+from dedloc_tpu.averaging.matchmaking import Matchmaking, GroupInfo
+from dedloc_tpu.averaging.averager import DecentralizedAverager

@@ -1,0 +1,243 @@
+"""Fault-tolerant group all-reduce over TCP: reduce-scatter + all-gather.
+
+The cross-slice replacement for hivemind's butterfly all-reduce
+(SURVEY.md §2.6): each group member hosts one bandwidth-weighted span of the
+flat vector; senders scatter their spans to the hosts, each host computes the
+weighted average of its span, then everyone gathers the reduced spans back.
+Weighted by per-peer sample counts so the result is the exact weighted mean
+of member vectors.
+
+Roles inside a group (capability parity with the reference):
+- normal peer: weight > 0, bandwidth > 0 — sends data AND hosts a span
+- auxiliary peer (run_aux.py): weight == 0, bandwidth > 0 — hosts a span,
+  contributes bandwidth, sends no data
+- client-mode peer (arguments.py:63-65): bandwidth == 0 — sends data and
+  pulls results, hosts nothing (outbound connections only)
+
+Failure contract (mirrors the reference's straggler SLA,
+albert/arguments.py:23-28): a SENDER that misses the ``straggler_timeout``
+window is simply left out — hosts reduce whatever arrived by then, and all
+members still gather identical spans (consistent result, minus the
+straggler's contribution). A dead HOST is unrecoverable without redundancy:
+its span cannot be gathered, the round raises AllreduceFailed for everyone,
+and the group re-forms next round (the reference's 'group failure costs one
+round' semantics, contributor notebook cell 3).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dedloc_tpu.core.serialization import (
+    CompressionType,
+    deserialize_array,
+    serialize_array,
+)
+from dedloc_tpu.averaging.partition import partition_weighted
+from dedloc_tpu.dht.protocol import Endpoint, RPCClient, RPCServer
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class AllreduceFailed(Exception):
+    pass
+
+
+class _RoundState:
+    def __init__(self):
+        self.parts: Dict[int, Tuple[np.ndarray, float]] = {}  # sender -> (span, weight)
+        self.expected_senders: Optional[set] = None
+        self.arrived = asyncio.Event()
+        self.reduced: asyncio.Future = asyncio.get_running_loop().create_future()
+
+    def maybe_complete(self) -> None:
+        if self.expected_senders is not None and self.expected_senders <= set(
+            self.parts
+        ):
+            self.arrived.set()
+
+
+class GroupAllReduce:
+    """Hosts the RPC handlers and runs rounds. One instance per peer process;
+    multiple concurrent rounds are keyed by round_id."""
+
+    def __init__(
+        self,
+        client: RPCClient,
+        server: Optional[RPCServer] = None,
+        compression: CompressionType = CompressionType.FLOAT16,
+        timeout: float = 30.0,
+        straggler_timeout: float = 5.0,
+    ):
+        self.client = client
+        self.compression = compression
+        self.timeout = timeout
+        self.straggler_timeout = straggler_timeout
+        self._rounds: Dict[str, _RoundState] = {}
+        if server is not None:
+            server.register("avg.part", self._rpc_part)
+            server.register("avg.get_reduced", self._rpc_get_reduced)
+
+    def _round(self, round_id: str) -> _RoundState:
+        if round_id not in self._rounds:
+            self._rounds[round_id] = _RoundState()
+            # bound handler-created entries too: without this, parts arriving
+            # after run()'s cleanup would accumulate forever
+            asyncio.get_running_loop().call_later(
+                self.timeout * 2, self._rounds.pop, round_id, None
+            )
+        return self._rounds[round_id]
+
+    # ------------------------------------------------------------- handlers
+
+    async def _rpc_part(self, peer: Endpoint, args) -> dict:
+        """A sender delivers its slice of MY span (or a zero-weight marker
+        from an auxiliary peer that has no data)."""
+        state = self._round(args["round_id"])
+        weight = float(args["weight"])
+        span = (
+            deserialize_array(args["data"]).astype(np.float32)
+            if args.get("data") is not None
+            else None
+        )
+        state.parts[int(args["sender"])] = (span, weight)
+        state.maybe_complete()
+        return {}
+
+    async def _rpc_get_reduced(self, peer: Endpoint, args) -> dict:
+        """A member pulls my reduced span (awaits until reduction done)."""
+        state = self._round(args["round_id"])
+        data, weight = await asyncio.wait_for(
+            asyncio.shield(state.reduced), timeout=self.timeout
+        )
+        return {"data": serialize_array(data, self.compression), "weight": weight}
+
+    # ------------------------------------------------------------------ run
+
+    async def run(
+        self,
+        round_id: str,
+        my_index: int,
+        vector: np.ndarray,
+        weight: float,
+        endpoints: Sequence[Optional[Endpoint]],
+        bandwidths: Sequence[float],
+    ) -> np.ndarray:
+        """Run one round. ``endpoints[i] is None`` marks a client-mode member
+        (it hosts nothing); my own endpoint entry is ignored. Returns the
+        weighted average vector (same shape as input).
+        """
+        n = len(endpoints)
+        assert 0 <= my_index < n
+        spans = partition_weighted(len(vector), list(bandwidths))
+        # every member announces itself to every host — auxiliary peers send a
+        # zero-weight marker instead of data, so hosts know not to wait
+        senders = set(range(n))
+
+        my_state = None
+        lo, hi = spans[my_index]
+        hosts_span = hi > lo
+        if hosts_span:
+            my_state = self._round(round_id)
+            my_state.expected_senders = set(senders)
+            my_state.maybe_complete()
+
+        try:
+            return await asyncio.wait_for(
+                self._run_inner(
+                    round_id, my_index, vector, weight, endpoints, spans,
+                    my_state, senders,
+                ),
+                timeout=self.timeout,
+            )
+        except (asyncio.TimeoutError, ConnectionError, OSError) as e:
+            raise AllreduceFailed(f"round {round_id}: {e!r}") from e
+        finally:
+            # deferred cleanup: slower members may still pull our reduced span
+            asyncio.get_running_loop().call_later(
+                self.timeout, self._rounds.pop, round_id, None
+            )
+
+    async def _run_inner(
+        self, round_id, my_index, vector, weight, endpoints, spans, my_state,
+        senders,
+    ) -> np.ndarray:
+        n = len(endpoints)
+        # 1) scatter: send my slice of each host's span (zero-weight marker
+        # when I have no data, so hosts never wait on an aux peer)
+        sends = []
+        for j in range(n):
+            lo, hi = spans[j]
+            if hi <= lo:
+                continue  # client-mode host: nothing to send
+            if j == my_index:
+                my_state.parts[my_index] = (
+                    vector[lo:hi].astype(np.float32) if weight > 0 else None,
+                    weight if weight > 0 else 0.0,
+                )
+                my_state.maybe_complete()
+                continue
+            payload = {
+                "round_id": round_id,
+                "sender": my_index,
+                "weight": weight if weight > 0 else 0.0,
+                "data": (
+                    serialize_array(vector[lo:hi], self.compression)
+                    if weight > 0
+                    else None
+                ),
+            }
+            sends.append(
+                self.client.call(
+                    endpoints[j], "avg.part", payload, timeout=self.timeout
+                )
+            )
+        await asyncio.gather(*sends)
+
+        # 2) reduce my span once all expected parts arrive — or after the
+        # straggler window closes (arguments.py:23-28 semantics): reduce what
+        # we have; the missing sender simply doesn't contribute this round
+        if my_state is not None:
+            try:
+                await asyncio.wait_for(
+                    my_state.arrived.wait(), timeout=self.straggler_timeout
+                )
+            except asyncio.TimeoutError:
+                missing = (my_state.expected_senders or set()) - set(my_state.parts)
+                logger.warning(
+                    f"{round_id}: proceeding without stragglers {sorted(missing)}"
+                )
+            total_w = sum(w for p, w in my_state.parts.values() if p is not None)
+            lo, hi = spans[my_index]
+            if total_w > 0:
+                acc = np.zeros(hi - lo, np.float32)
+                for part, w in my_state.parts.values():
+                    if part is not None and w > 0:
+                        acc += part * w
+                reduced = acc / total_w
+            else:  # all-aux group: nothing to average
+                reduced = vector[lo:hi].astype(np.float32)
+            my_state.reduced.set_result((reduced, total_w))
+
+        # 3) gather all reduced spans
+        async def fetch(j: int) -> np.ndarray:
+            lo, hi = spans[j]
+            if hi <= lo:
+                return np.zeros(0, np.float32)
+            if j == my_index:
+                return (await my_state.reduced)[0]
+            reply = await self.client.call(
+                endpoints[j],
+                "avg.get_reduced",
+                {"round_id": round_id},
+                timeout=self.timeout,
+            )
+            return deserialize_array(reply["data"]).astype(np.float32)
+
+        pieces = await asyncio.gather(*(fetch(j) for j in range(n)))
+        out = np.concatenate(pieces)
+        assert out.size == vector.size
+        return out

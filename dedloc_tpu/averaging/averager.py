@@ -1,0 +1,263 @@
+"""DecentralizedAverager: matchmaking + group all-reduce + state sharing.
+
+The TPU-native counterpart of hivemind.DecentralizedAverager as consumed via
+CollaborativeOptimizer (SURVEY.md §2.6). Runs entirely on the DHT facade's
+event loop; exposes a synchronous ``step`` for the trainer thread.
+
+In the TPU design the entity calling ``step`` is one pod SLICE (gradients
+already psum-reduced over ICI by the jitted step); this class only moves
+bytes across slices over DCN/TCP.
+"""
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from dedloc_tpu.averaging.allreduce import AllreduceFailed, GroupAllReduce
+from dedloc_tpu.averaging.matchmaking import (
+    GroupInfo,
+    Matchmaking,
+    MatchmakingFailed,
+)
+from dedloc_tpu.averaging.partition import flatten_tree, unflatten_tree
+from dedloc_tpu.core.serialization import (
+    CompressionType,
+    deserialize_tree,
+    pack_obj,
+    serialize_tree,
+    unpack_obj,
+)
+from dedloc_tpu.core.timeutils import get_dht_time
+from dedloc_tpu.dht.dht import DHT
+from dedloc_tpu.dht.protocol import RPCClient, RPCServer
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class DecentralizedAverager:
+    def __init__(
+        self,
+        dht: DHT,
+        prefix: str,
+        bandwidth: float = 1000.0,
+        client_mode: bool = False,
+        auxiliary: bool = False,
+        allow_state_sharing: bool = True,
+        compression: str | CompressionType = CompressionType.FLOAT16,
+        averaging_expiration: float = 5.0,
+        averaging_timeout: float = 30.0,
+        target_group_size: int = 256,
+        listen_host: str = "0.0.0.0",
+        listen_port: int = 0,
+        advertised_host: Optional[str] = None,
+    ):
+        self.dht = dht
+        self.prefix = prefix
+        self.client_mode = client_mode
+        self.auxiliary = auxiliary
+        self.allow_state_sharing = allow_state_sharing and not client_mode
+        self.compression = (
+            CompressionType(compression)
+            if isinstance(compression, str)
+            else compression
+        )
+        self.averaging_expiration = averaging_expiration
+        self.averaging_timeout = averaging_timeout
+        self.target_group_size = target_group_size
+        self._listen = (listen_host, listen_port)
+        self._advertised_host = advertised_host or "127.0.0.1"
+        self._shared_state: Optional[Tuple[Dict[str, np.ndarray], Dict[str, Any]]] = None
+        self._shared_state_blob: Optional[bytes] = None
+        self._state_lock = threading.Lock()
+        self.server: Optional[RPCServer] = None
+        self.endpoint = None
+        self.last_group_size: int = 1
+
+        # build server+matchmaking+allreduce on the DHT loop
+        def _setup(node):
+            async def setup():
+                self.client = RPCClient(request_timeout=averaging_timeout)
+                if not client_mode:
+                    self.server = RPCServer(*self._listen)
+                    self.server.register("state.get", self._rpc_state_get)
+                    await self.server.start()
+                    self.endpoint = (self._advertised_host, self.server.port)
+                self.peer_id = node.node_id.to_bytes()
+                self.allreduce = GroupAllReduce(
+                    self.client,
+                    self.server,
+                    compression=self.compression,
+                    timeout=averaging_timeout,
+                    straggler_timeout=averaging_expiration,
+                )
+                self.matchmaking = Matchmaking(
+                    node,
+                    self.client,
+                    self.server,
+                    prefix,
+                    self.peer_id,
+                    self.endpoint,
+                    bandwidth,
+                    target_group_size=target_group_size,
+                    averaging_expiration=averaging_expiration,
+                )
+
+            return setup()
+
+        dht.run_coroutine(_setup)
+
+    # ------------------------------------------------------------ averaging
+
+    def step(
+        self,
+        tree: Dict[str, np.ndarray],
+        weight: float,
+        round_id: str,
+        return_future: bool = False,
+    ):
+        """Average ``tree`` with whatever group forms for ``round_id``.
+
+        Returns (averaged_tree | None, group_size); None means the round
+        failed and the caller should proceed with its local values
+        (reference semantics: a failed group costs one round, nothing else).
+        """
+
+        def _run(node):
+            return self._step_async(tree, weight, round_id)
+
+        fut = self.dht.run_coroutine(_run, return_future=True)
+        return fut if return_future else fut.result()
+
+    async def _step_async(
+        self, tree: Dict[str, np.ndarray], weight: float, round_id: str
+    ) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
+        try:
+            group = await self.matchmaking.form_group(round_id)
+        except MatchmakingFailed as e:
+            logger.debug(f"matchmaking failed for {round_id}: {e}")
+            return None, 1
+        self.last_group_size = len(group.members)
+        if len(group.members) == 1:
+            return (tree if weight > 0 else None), 1
+        flat, spec = flatten_tree(tree)
+        try:
+            averaged = await self.allreduce.run(
+                f"{self.prefix}:{round_id}:{group.members[0].peer_id.hex()[:8]}",
+                group.my_index,
+                flat,
+                weight,
+                group.endpoints,
+                group.bandwidths,
+            )
+        except AllreduceFailed as e:
+            logger.warning(f"allreduce failed for {round_id}: {e}")
+            return None, len(group.members)
+        return unflatten_tree(averaged, spec), len(group.members)
+
+    # --------------------------------------------------------- state sharing
+
+    def set_shared_state(
+        self, tree: Dict[str, np.ndarray], metadata: Dict[str, Any]
+    ) -> None:
+        """Snapshot current training state for late joiners
+        (load_state_from_peers counterpart, albert/run_trainer.py:124-128).
+        Stores references only — serialization is deferred to the moment a
+        peer actually requests the state (off the training thread)."""
+        with self._state_lock:
+            self._shared_state = (tree, metadata)
+            self._shared_state_blob = None  # invalidate serialized cache
+
+    async def _rpc_state_get(self, peer, args) -> dict:
+        if not self.allow_state_sharing:
+            raise PermissionError("state sharing disabled on this peer")
+        with self._state_lock:
+            snapshot = self._shared_state
+            blob = self._shared_state_blob
+        if snapshot is None:
+            raise FileNotFoundError("no state snapshot available yet")
+        if blob is None:
+            tree, metadata = snapshot
+            blob = pack_obj(
+                {
+                    "metadata": pack_obj(metadata),
+                    "tree": serialize_tree(tree, CompressionType.NONE),
+                }
+            )
+            with self._state_lock:
+                if self._shared_state is snapshot:  # not replaced meanwhile
+                    self._shared_state_blob = blob
+        return {"state": blob}
+
+    def publish_state_provider(
+        self, expiration: float = 60.0, step: int = 0
+    ) -> None:
+        """Advertise this peer as a state provider, with its global step so
+        joiners can prefer the NEWEST snapshot."""
+        if not self.allow_state_sharing or self.endpoint is None:
+            return
+        self.dht.store(
+            f"{self.prefix}_state_providers",
+            {"endpoint": list(self.endpoint), "step": int(step)},
+            get_dht_time() + expiration,
+            subkey=self.peer_id,
+        )
+
+    def load_state_from_peers(
+        self, timeout: float = 60.0
+    ) -> Optional[Tuple[Dict[str, Any], Dict[str, np.ndarray]]]:
+        """Download (metadata, tree) from any live state provider."""
+        entry = self.dht.get(f"{self.prefix}_state_providers", latest=True)
+        if entry is None or not hasattr(entry.value, "items"):
+            return None
+        candidates = []
+        for sk, v in entry.value.items():
+            if sk == getattr(self, "peer_id", None):
+                continue
+            try:
+                candidates.append(
+                    (int(v.value.get("step", 0)), tuple(v.value["endpoint"]))
+                )
+            except Exception:  # noqa: BLE001
+                continue
+        # newest snapshot first — a stale provider must not win the race
+        candidates.sort(key=lambda c: -c[0])
+        providers = [ep for _step, ep in candidates]
+
+        def _fetch(node):
+            async def fetch():
+                for ep in providers:
+                    try:
+                        reply = await self.client.call(
+                            ep, "state.get", {}, timeout=timeout
+                        )
+                        obj = unpack_obj(reply["state"])
+                        return (
+                            unpack_obj(obj["metadata"]),
+                            deserialize_tree(obj["tree"]),
+                        )
+                    except Exception as e:  # noqa: BLE001 — try next provider
+                        logger.debug(f"state fetch from {ep} failed: {e!r}")
+                return None
+
+            return fetch()
+
+        return self.dht.run_coroutine(_fetch)
+
+    def shutdown(self) -> None:
+        def _stop(node):
+            async def stop():
+                await self.client.close()
+                if self.server is not None:
+                    await self.server.stop()
+
+            return stop()
+
+        try:
+            self.dht.run_coroutine(_stop)
+        except Exception:  # noqa: BLE001 — best effort
+            pass

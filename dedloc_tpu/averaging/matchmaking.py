@@ -1,0 +1,237 @@
+"""DHT-driven matchmaking: assemble bounded peer groups for each round.
+
+Capability parity with hivemind's DecentralizedAverager matchmaking
+(SURVEY.md §2.6: ``target_group_size``, ``averaging_expiration`` straggler
+window): peers that decide to average for round R either JOIN an already
+declared leader (blocking RPC that returns the assembled group) or DECLARE
+themselves leader in the DHT and accept joins until their deadline.
+
+Concurrent leaders are not an error: each assembles its own group, groups
+average independently, and group composition rotates across rounds (leader
+choice is ranked by hash(round_id, leader_id)) — the same gossip-style
+mixing DeDLOC relies on (contributor notebook cell 3: group failure only
+costs that group one round).
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dedloc_tpu.core.serialization import pack_obj, unpack_obj
+from dedloc_tpu.core.timeutils import get_dht_time
+from dedloc_tpu.dht.node import DHTNode
+from dedloc_tpu.dht.protocol import Endpoint, RPCClient, RPCServer
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class Member:
+    peer_id: bytes
+    endpoint: Optional[Endpoint]  # None for client-mode members
+    bandwidth: float
+
+    def pack(self) -> list:
+        ep = list(self.endpoint) if self.endpoint else None
+        return [self.peer_id, ep, self.bandwidth]
+
+    @classmethod
+    def unpack(cls, raw) -> "Member":
+        ep = tuple(raw[1]) if raw[1] else None
+        return cls(raw[0], ep, float(raw[2]))
+
+
+@dataclass
+class GroupInfo:
+    round_id: str
+    members: List[Member]  # sorted by peer_id — identical on every member
+    my_index: int
+
+    @property
+    def endpoints(self) -> List[Optional[Endpoint]]:
+        return [m.endpoint for m in self.members]
+
+    @property
+    def bandwidths(self) -> List[float]:
+        # client-mode members host nothing
+        return [m.bandwidth if m.endpoint else 0.0 for m in self.members]
+
+
+class MatchmakingFailed(Exception):
+    pass
+
+
+class Matchmaking:
+    """One per peer. Needs the peer's RPC server (None in client mode) and
+    its DHTNode (all calls run on the node's event loop)."""
+
+    def __init__(
+        self,
+        node: DHTNode,
+        client: RPCClient,
+        server: Optional[RPCServer],
+        prefix: str,
+        peer_id: bytes,
+        endpoint: Optional[Endpoint],
+        bandwidth: float,
+        target_group_size: int = 256,
+        averaging_expiration: float = 5.0,
+    ):
+        self.node = node
+        self.client = client
+        self.prefix = prefix
+        self.peer_id = peer_id
+        self.endpoint = endpoint  # None => client mode
+        self.bandwidth = bandwidth if endpoint is not None else 0.0
+        self.target_group_size = target_group_size
+        self.averaging_expiration = averaging_expiration
+        # leader state: round_id -> (members dict, assembled event)
+        self._leading: Dict[str, Tuple[Dict[bytes, Member], asyncio.Event]] = {}
+        if server is not None:
+            server.register("mm.join", self._rpc_join)
+
+    def _leaders_key(self, round_id: str) -> bytes:
+        return f"{self.prefix}_leaders_{round_id}".encode()
+
+    # ------------------------------------------------------------- leader
+
+    async def _rpc_join(self, peer: Endpoint, args) -> dict:
+        round_id = args["round_id"]
+        member = Member.unpack(args["member"])
+        entry = self._leading.get(round_id)
+        if entry is None:
+            raise MatchmakingFailed(f"not leading round {round_id}")
+        members, assembled = entry
+        if assembled.is_set():
+            raise MatchmakingFailed(f"round {round_id} already assembled")
+        if len(members) >= self.target_group_size:
+            raise MatchmakingFailed(f"round {round_id} is full")
+        members[member.peer_id] = member
+        await assembled.wait()
+        group = sorted(members.values(), key=lambda m: m.peer_id)
+        return {"members": [m.pack() for m in group]}
+
+    async def _lead(
+        self, round_id: str, deadline: float, allow_abandon: bool
+    ) -> Optional[GroupInfo]:
+        """Lead a group until ``deadline``. Returns None if leadership was
+        abandoned in favour of a better-ranked concurrent leader (only ever
+        done while we still have zero followers — atomic w.r.t. the loop)."""
+        me = Member(self.peer_id, self.endpoint, self.bandwidth)
+        members: Dict[bytes, Member] = {self.peer_id: me}
+        assembled = asyncio.Event()
+        self._leading[round_id] = (members, assembled)
+        my_rank = self._rank(round_id, self.peer_id)
+        try:
+            await self.node.store(
+                self._leaders_key(round_id),
+                pack_obj({"endpoint": list(self.endpoint)}),
+                deadline,
+                subkey=self.peer_id,
+            )
+            check_period = max(0.05, self.averaging_expiration / 5)
+            while True:
+                remaining = deadline - get_dht_time()
+                if remaining <= 0:
+                    break
+                await asyncio.sleep(min(check_period, remaining))
+                # two peers may have declared simultaneously: the one with
+                # the worse rank (and no followers yet) defects and re-joins
+                if allow_abandon and len(members) == 1:
+                    entry = await self.node.get(
+                        self._leaders_key(round_id), latest=True
+                    )
+                    if entry is not None and hasattr(entry.value, "items"):
+                        better = [
+                            sk
+                            for sk, v in entry.value.items()
+                            if sk != self.peer_id
+                            and v.expiration_time > get_dht_time()
+                            and self._rank(round_id, sk) < my_rank
+                        ]
+                        if better and len(members) == 1:
+                            self._leading.pop(round_id, None)
+                            return None
+        finally:
+            assembled.set()  # joiners get their reply even if store failed
+        group = sorted(members.values(), key=lambda m: m.peer_id)
+        # let pending join handlers finish serializing before cleanup
+        asyncio.get_running_loop().call_later(
+            self.averaging_expiration, self._leading.pop, round_id, None
+        )
+        return GroupInfo(round_id, group, group.index(me))
+
+    # ------------------------------------------------------------ follower
+
+    async def _try_join(self, round_id: str, leader_ep: Endpoint) -> GroupInfo:
+        me = Member(self.peer_id, self.endpoint, self.bandwidth)
+        reply = await self.client.call(
+            leader_ep,
+            "mm.join",
+            {"round_id": round_id, "member": me.pack()},
+            timeout=self.averaging_expiration * 3 + 5.0,
+        )
+        members = [Member.unpack(r) for r in reply["members"]]
+        ids = [m.peer_id for m in members]
+        if self.peer_id not in ids:
+            raise MatchmakingFailed("leader did not include us")
+        return GroupInfo(round_id, members, ids.index(self.peer_id))
+
+    def _rank(self, round_id: str, leader_id: bytes) -> bytes:
+        return hashlib.sha256(round_id.encode() + leader_id).digest()
+
+    # ----------------------------------------------------------------- main
+
+    async def _live_leaders(self, round_id: str) -> List[Tuple[bytes, Endpoint]]:
+        entry = await self.node.get(self._leaders_key(round_id), latest=True)
+        now = get_dht_time()
+        leaders: List[Tuple[bytes, Endpoint]] = []
+        if entry is not None and hasattr(entry.value, "items"):
+            for sk, v in entry.value.items():
+                if v.expiration_time <= now:
+                    continue
+                try:
+                    info = unpack_obj(v.value)
+                    leaders.append((sk, tuple(info["endpoint"])))
+                except Exception:  # noqa: BLE001 — malformed entry
+                    continue
+        leaders.sort(key=lambda kv: self._rank(round_id, kv[0]))
+        return leaders
+
+    async def form_group(self, round_id: str) -> GroupInfo:
+        """Join an existing leader or lead; returns the assembled group
+        (possibly a singleton if nobody else showed up). Client-mode peers
+        cannot lead, so they keep polling for a leader within the straggler
+        window instead of failing instantly on a startup race."""
+        allow_abandon = True
+        deadline = get_dht_time() + self.averaging_expiration * 2
+        attempt = 0
+        while True:
+            attempt += 1
+            for leader_id, leader_ep in await self._live_leaders(round_id):
+                if leader_id == self.peer_id:
+                    continue
+                try:
+                    return await self._try_join(round_id, leader_ep)
+                except Exception as e:  # noqa: BLE001 — try next leader
+                    logger.debug(f"join {leader_ep} failed: {e!r}")
+                    continue
+            if self.endpoint is None:
+                if get_dht_time() >= deadline:
+                    raise MatchmakingFailed(
+                        "client-mode peer found no joinable leader for this round"
+                    )
+                await asyncio.sleep(
+                    min(0.3, max(0.05, self.averaging_expiration / 10))
+                )
+                continue
+            if attempt > 3:
+                raise MatchmakingFailed(f"could not form a group for {round_id}")
+            lead_deadline = get_dht_time() + self.averaging_expiration
+            group = await self._lead(round_id, lead_deadline, allow_abandon)
+            if group is not None:
+                return group
+            allow_abandon = False  # abandoned once; never defect again

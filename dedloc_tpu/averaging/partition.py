@@ -1,0 +1,64 @@
+"""Tensor flattening and bandwidth-weighted partitioning for group all-reduce.
+
+Capability parity with hivemind's load-balanced butterfly partitioning
+(``throughput=bandwidth`` at albert/run_trainer.py:258, SURVEY.md §2.6):
+peers with more bandwidth reduce proportionally larger chunks, so the round
+finishes in min-max-optimal time. Client-mode/zero-bandwidth peers get zero-
+size parts — they contribute data but never host a reduction.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+def flatten_tree(tree: Dict[str, np.ndarray]) -> Tuple[np.ndarray, List[Tuple[str, Tuple[int, ...], np.dtype]]]:
+    """Flatten {name: array} into one fp32 vector + layout spec (sorted by name
+    so every peer produces the identical layout)."""
+    spec = []
+    chunks = []
+    for name in sorted(tree):
+        arr = np.asarray(tree[name])
+        spec.append((name, arr.shape, arr.dtype))
+        chunks.append(arr.astype(np.float32).ravel())
+    flat = np.concatenate(chunks) if chunks else np.zeros((0,), np.float32)
+    return flat, spec
+
+
+def unflatten_tree(
+    flat: np.ndarray, spec: Sequence[Tuple[str, Tuple[int, ...], np.dtype]]
+) -> Dict[str, np.ndarray]:
+    out = {}
+    offset = 0
+    for name, shape, dtype in spec:
+        size = int(np.prod(shape)) if shape else 1
+        out[name] = flat[offset : offset + size].reshape(shape).astype(dtype)
+        offset += size
+    assert offset == flat.size, "layout spec does not match vector length"
+    return out
+
+
+def partition_weighted(total_size: int, bandwidths: Sequence[float]) -> List[Tuple[int, int]]:
+    """Split [0, total_size) into len(bandwidths) contiguous spans with sizes
+    proportional to bandwidth (largest-remainder rounding; exact cover)."""
+    n = len(bandwidths)
+    assert n > 0
+    bw = np.asarray(bandwidths, dtype=np.float64)
+    bw = np.where(np.isfinite(bw) & (bw > 0), bw, 0.0)
+    if bw.sum() <= 0:
+        bw = np.ones(n)
+    ideal = bw / bw.sum() * total_size
+    sizes = np.floor(ideal).astype(np.int64)
+    remainder = int(total_size - sizes.sum())
+    # distribute leftover to the largest fractional parts
+    order = np.argsort(-(ideal - sizes))
+    for i in range(remainder):
+        sizes[order[i % n]] += 1
+    spans = []
+    offset = 0
+    for s in sizes:
+        spans.append((offset, offset + int(s)))
+        offset += int(s)
+    assert offset == total_size
+    return spans

@@ -1,0 +1,6 @@
+from dedloc_tpu.collaborative.progress import (
+    LocalProgress,
+    CollaborationState,
+    ProgressTracker,
+)
+from dedloc_tpu.collaborative.optimizer import CollaborativeOptimizer

@@ -1,0 +1,351 @@
+"""CollaborativeOptimizer: the TPU-native DeDLOC training driver.
+
+Semantics parity with hivemind.CollaborativeOptimizer as consumed by all
+three reference trainers (SURVEY.md §2.6, §3.1): accumulate gradients
+locally until the COLLABORATION-wide sample count reaches
+``target_batch_size``, then form a group, average gradients (weighted by
+each peer's accumulated samples) and apply one optimizer step keyed by the
+GLOBAL step counter. Exposes ``local_step``, ``collaboration_state``,
+``is_synchronized``, ``performance_ema``, ``local_samples_accumulated``,
+``load_state_from_peers`` and ``step_aux`` — the exact attribute surface the
+reference trainers consume.
+
+TPU-native split (SURVEY.md §7 hard-parts b,c):
+- the hot path stays jitted: callers run ``make_accumulate_step`` per
+  micro-batch with a device-resident, donated grad accumulator;
+- ``step`` crosses the jit↔asyncio seam exactly once per GLOBAL step
+  (device_get of the mean grads), not per micro-batch;
+- the slice (not the chip) is the collaboration peer: in-slice averaging is
+  the psum XLA already inserted, this class only averages across slices.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+import optax
+
+from dedloc_tpu.averaging.averager import DecentralizedAverager
+from dedloc_tpu.collaborative.progress import (
+    CollaborationState,
+    LocalProgress,
+    ProgressTracker,
+)
+from dedloc_tpu.core.timeutils import PerformanceEMA, get_dht_time
+from dedloc_tpu.dht.dht import DHT
+from dedloc_tpu.parallel.train_step import (
+    TrainState,
+    make_apply_step,
+    params_are_finite,
+    zeros_like_grads,
+)
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _tree_to_named(tree) -> Dict[str, np.ndarray]:
+    """Flatten a pytree into {path: np.array} with deterministic names."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = {}
+    for i, (path, leaf) in enumerate(flat):
+        name = jax.tree_util.keystr(path) or f"leaf{i}"
+        out[name] = np.asarray(leaf)
+    return out
+
+
+def _named_to_tree(named: Dict[str, np.ndarray], like):
+    """Inverse of _tree_to_named given a structural template."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+    leaves = []
+    for i, (path, leaf) in enumerate(flat):
+        name = jax.tree_util.keystr(path) or f"leaf{i}"
+        arr = named[name]
+        leaves.append(np.asarray(arr, dtype=leaf.dtype).reshape(leaf.shape))
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(like), leaves
+    )
+
+
+class CollaborativeOptimizer:
+    def __init__(
+        self,
+        tx: optax.GradientTransformation,
+        dht: DHT,
+        prefix: str,
+        target_batch_size: int = 4096,
+        batch_size_per_step: Optional[int] = None,
+        bandwidth: float = 1000.0,
+        compression: str = "float16",
+        target_group_size: int = 256,
+        averaging_expiration: float = 5.0,
+        averaging_timeout: float = 30.0,
+        metadata_expiration: float = 30.0,
+        statistics_expiration: float = 600.0,
+        min_refresh_period: float = 0.5,
+        max_refresh_period: float = 30.0,
+        default_refresh_period: float = 3.0,
+        expected_drift_peers: float = 3.0,
+        expected_drift_rate: float = 0.2,
+        performance_ema_alpha: float = 0.1,
+        client_mode: bool = False,
+        auxiliary: bool = False,
+        allow_state_sharing: bool = True,
+        mesh=None,
+        verbose: bool = False,
+        listen_host: str = "0.0.0.0",
+        advertised_host: Optional[str] = None,
+    ):
+        assert not (client_mode and auxiliary), "an auxiliary peer must listen"
+        self.tx = tx
+        self.dht = dht
+        self.prefix = prefix
+        self.target_batch_size = target_batch_size
+        self.batch_size_per_step = batch_size_per_step
+        self.client_mode = client_mode
+        self.auxiliary = auxiliary
+        self.verbose = verbose
+        self.statistics_expiration = statistics_expiration
+
+        self.averager = DecentralizedAverager(
+            dht,
+            prefix,
+            bandwidth=bandwidth,
+            client_mode=client_mode,
+            auxiliary=auxiliary,
+            allow_state_sharing=allow_state_sharing and not auxiliary,
+            compression=compression,
+            averaging_expiration=averaging_expiration,
+            averaging_timeout=averaging_timeout,
+            target_group_size=target_group_size,
+            listen_host=listen_host,
+            advertised_host=advertised_host,
+        )
+        self.tracker = ProgressTracker(
+            dht,
+            prefix,
+            peer_subkey=self.averager.peer_id,
+            target_batch_size=target_batch_size,
+            min_refresh_period=min_refresh_period,
+            max_refresh_period=max_refresh_period,
+            default_refresh_period=default_refresh_period,
+            metadata_expiration=metadata_expiration,
+            expected_drift_peers=expected_drift_peers,
+            expected_drift_rate=expected_drift_rate,
+        )
+        self.performance_ema = PerformanceEMA(alpha=performance_ema_alpha)
+        self.local_step = 0
+        self.local_samples_accumulated = 0
+        self._apply_fn = make_apply_step(tx, mesh=mesh)
+        self._lock = threading.Lock()
+        self._last_good: Optional[Tuple[Any, int]] = None  # host (params, opt)
+        self._desynced = False
+        self._round_failures = 0
+        self.max_round_retries = 2
+
+    # ------------------------------------------------------------ properties
+
+    @property
+    def collaboration_state(self) -> CollaborationState:
+        return self.tracker.fetch_collaboration_state()
+
+    @property
+    def is_synchronized(self) -> bool:
+        return self.local_step >= self.collaboration_state.optimizer_step
+
+    # ------------------------------------------------------------------ step
+
+    def step(
+        self,
+        state: TrainState,
+        grad_acc,
+        n_acc,
+        samples: int,
+    ) -> Tuple[TrainState, Any, Any, bool]:
+        """Per-accumulation-boundary call. Returns (state, grad_acc, n_acc,
+        performed_global_step). All heavy work happens only when the global
+        target batch is reached."""
+        assert not self.auxiliary, "auxiliary peers must use step_aux()"
+        with self._lock:
+            self.local_samples_accumulated += samples
+            if self.performance_ema.num_updates == 0:
+                # ignore compile time in throughput stats
+                self.performance_ema.resume()
+            self.performance_ema.update(samples)
+
+            collab = self.tracker.fetch_collaboration_state()
+            if collab.optimizer_step > self.local_step or self._desynced:
+                # we fell behind (or our last round failed while others
+                # averaged) — catch up from peers
+                state = self._catch_up(state, collab)
+                self._desynced = False
+                grad_acc = zeros_like_grads(state.params)
+                n_acc = jax.numpy.zeros([], jax.numpy.int32)
+                self.local_samples_accumulated = 0
+                self._report(synced=True)
+                return state, grad_acc, n_acc, False
+
+            self._report(synced=True)
+            if not collab.ready_for_step:
+                return state, grad_acc, n_acc, False
+
+            return self._global_step(state, grad_acc, n_acc, collab)
+
+    def _report(self, synced: bool) -> None:
+        self.tracker.report_local_progress(
+            LocalProgress(
+                step=self.local_step,
+                samples_accumulated=self.local_samples_accumulated,
+                samples_per_second=self.performance_ema.samples_per_second,
+                time=get_dht_time(),
+                client_mode=self.client_mode,
+            )
+        )
+
+    def _global_step(self, state: TrainState, grad_acc, n_acc, collab):
+        """Average gradients with the group and apply one optimizer update."""
+        round_id = f"step{collab.optimizer_step}"
+        n = max(int(jax.device_get(n_acc)), 1)
+        mean_grads = jax.tree.map(lambda g: g / n, grad_acc)
+        named = _tree_to_named(mean_grads)
+
+        self.performance_ema.pause()
+        try:
+            averaged, group_size = self.averager.step(
+                named, weight=float(self.local_samples_accumulated), round_id=round_id
+            )
+            if averaged is not None:
+                mean_grads = _named_to_tree(averaged, mean_grads)
+                self._round_failures = 0
+            elif collab.num_peers > 1:
+                self._round_failures += 1
+                if self._round_failures <= self.max_round_retries:
+                    # better than the reference's local-apply: KEEP the
+                    # accumulated gradients and retry the round — no
+                    # divergence, no wasted samples (one straggler window
+                    # lost instead)
+                    if self.verbose:
+                        logger.warning(
+                            f"{round_id}: averaging failed "
+                            f"({self._round_failures}/{self.max_round_retries})"
+                            " — keeping grads, will retry"
+                        )
+                    return state, grad_acc, n_acc, False
+                # repeated failures: apply local grads to make progress, and
+                # schedule a state pull since our params will diverge
+                self._desynced = True
+                self._round_failures = 0
+                if self.verbose:
+                    logger.warning(
+                        f"{round_id}: averaging failed repeatedly — applying "
+                        "local grads, will resync"
+                    )
+            new_state = self._apply_fn(state, mean_grads)
+            if not bool(params_are_finite(new_state.params)):
+                # NaN guard (CollaborativeCallback.on_step_end semantics,
+                # albert/run_trainer.py:134-137): discard this update
+                logger.warning(f"{round_id}: non-finite params; rolling back")
+                new_state = self._rollback(new_state)
+            self.local_step = collab.optimizer_step + 1
+            self.local_samples_accumulated = 0
+            self._backup_and_share(new_state)
+            self._report(synced=True)
+            self.tracker.fetch_collaboration_state(force=True)
+            if self.verbose:
+                logger.info(
+                    f"global step {self.local_step} applied "
+                    f"(group={group_size}, samples~{collab.samples_accumulated})"
+                )
+        finally:
+            self.performance_ema.resume()
+        return (
+            new_state,
+            zeros_like_grads(new_state.params),
+            jax.numpy.zeros([], jax.numpy.int32),
+            True,
+        )
+
+    # -------------------------------------------------------- state recovery
+
+    def _backup_and_share(self, state: TrainState) -> None:
+        """One device_get per global step serves both the NaN-rollback backup
+        (run_trainer.py:172-186) and the shared state for late joiners."""
+        host_state = jax.device_get((state.params, state.opt_state))
+        self._last_good = (host_state, int(state.step))
+        if self.averager.allow_state_sharing:
+            self.averager.set_shared_state(
+                _tree_to_named(host_state),
+                {"step": int(state.step), "local_step": self.local_step},
+            )
+            self.averager.publish_state_provider(
+                expiration=self.tracker.metadata_expiration * 4,
+                step=self.local_step,
+            )
+
+    def _rollback(self, state: TrainState) -> TrainState:
+        if self._last_good is None:
+            raise FloatingPointError(
+                "non-finite parameters and no backup to roll back to"
+            )
+        (params, opt_state), step = self._last_good
+        return state.replace(
+            step=jax.numpy.asarray(step, jax.numpy.int32),
+            params=jax.device_put(params),
+            opt_state=jax.device_put(opt_state),
+        )
+
+    def load_state_from_peers(self, state: TrainState) -> TrainState:
+        """Download the newest collaboration state (params+opt) from a peer
+        (albert/run_trainer.py:124-128 on_train_begin semantics). Returns the
+        local state unchanged if nobody shares yet."""
+        result = self.averager.load_state_from_peers()
+        if result is None:
+            logger.info("no state providers found; starting from local state")
+            return state
+        metadata, named = result
+        template = jax.device_get((state.params, state.opt_state))
+        try:
+            params, opt_state = _named_to_tree(named, template)
+        except (KeyError, ValueError) as e:
+            logger.warning(f"peer state incompatible ({e!r}); keeping local")
+            return state
+        self.local_step = int(metadata.get("local_step", metadata.get("step", 0)))
+        new_state = state.replace(
+            step=jax.numpy.asarray(int(metadata.get("step", 0)), jax.numpy.int32),
+            params=jax.device_put(params),
+            opt_state=jax.device_put(opt_state),
+        )
+        self._last_good = ((params, opt_state), int(metadata.get("step", 0)))
+        logger.info(f"loaded state from peers at global step {self.local_step}")
+        return new_state
+
+    def _catch_up(self, state: TrainState, collab) -> TrainState:
+        new_state = self.load_state_from_peers(state)
+        # even if nobody shares state, adopt the global step counter so we
+        # rejoin the current round instead of contesting old ones
+        self.local_step = max(self.local_step, collab.optimizer_step)
+        return new_state
+
+    # -------------------------------------------------------------- aux role
+
+    def step_aux(self, template: Dict[str, np.ndarray]) -> bool:
+        """Auxiliary peer (run_aux.py:260-263): join the current round with
+        zero weight, donating bandwidth. ``template`` gives tensor shapes."""
+        assert self.auxiliary
+        collab = self.tracker.fetch_collaboration_state()
+        if not collab.ready_for_step:
+            return False
+        round_id = f"step{collab.optimizer_step}"
+        zeros = {k: np.zeros_like(v) for k, v in template.items()}
+        averaged, group_size = self.averager.step(
+            zeros, weight=0.0, round_id=round_id
+        )
+        self.local_step = collab.optimizer_step + 1
+        self.tracker.fetch_collaboration_state(force=True)
+        return averaged is not None or group_size > 1
+
+    def shutdown(self) -> None:
+        self.averager.shutdown()

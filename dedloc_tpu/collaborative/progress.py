@@ -1,0 +1,146 @@
+"""Global progress tracking over the DHT.
+
+Capability parity with hivemind CollaborativeOptimizer's collaboration-state
+machinery (SURVEY.md §2.6): every peer publishes its local accumulation
+progress under ``{prefix}_progress``; the tracker aggregates to a global
+sample count, the current global optimizer step, peer counts and an ETA to
+the next step; the refresh period adapts between ``min_refresh_period`` and
+``max_refresh_period`` based on that ETA (albert/arguments.py:29-41).
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+from dedloc_tpu.core.timeutils import get_dht_time
+from dedloc_tpu.dht.dht import DHT
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class LocalProgress:
+    step: int
+    samples_accumulated: int
+    samples_per_second: float
+    time: float
+    client_mode: bool = False
+
+    def pack(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def unpack(cls, d: dict) -> "LocalProgress":
+        return cls(
+            step=int(d["step"]),
+            samples_accumulated=int(d["samples_accumulated"]),
+            samples_per_second=float(d["samples_per_second"]),
+            time=float(d["time"]),
+            client_mode=bool(d.get("client_mode", False)),
+        )
+
+
+@dataclass
+class CollaborationState:
+    optimizer_step: int
+    samples_accumulated: int  # collaboration-wide, towards the NEXT step
+    target_batch_size: int
+    num_peers: int
+    num_clients: int
+    eta_next_step: float  # seconds
+    next_fetch_time: float  # dht time
+
+    @property
+    def ready_for_step(self) -> bool:
+        return self.samples_accumulated >= self.target_batch_size
+
+
+class ProgressTracker:
+    def __init__(
+        self,
+        dht: DHT,
+        prefix: str,
+        peer_subkey: bytes,
+        target_batch_size: int,
+        min_refresh_period: float = 0.5,
+        max_refresh_period: float = 30.0,
+        default_refresh_period: float = 3.0,
+        metadata_expiration: float = 30.0,
+        expected_drift_peers: float = 3.0,
+        expected_drift_rate: float = 0.2,
+    ):
+        self.dht = dht
+        self.key = f"{prefix}_progress"
+        self.peer_subkey = peer_subkey
+        self.target_batch_size = target_batch_size
+        self.min_refresh_period = min_refresh_period
+        self.max_refresh_period = max_refresh_period
+        self.default_refresh_period = default_refresh_period
+        self.metadata_expiration = metadata_expiration
+        self.expected_drift_peers = expected_drift_peers
+        self.expected_drift_rate = expected_drift_rate
+        self._cached: Optional[CollaborationState] = None
+
+    def report_local_progress(self, progress: LocalProgress) -> None:
+        """Fire-and-forget publish of this peer's accumulation state."""
+        try:
+            self.dht.store(
+                self.key,
+                progress.pack(),
+                get_dht_time() + self.metadata_expiration,
+                subkey=self.peer_subkey,
+                return_future=True,  # don't block the training thread
+            )
+        except Exception as e:  # noqa: BLE001 — progress is best-effort
+            logger.debug(f"progress report failed: {e!r}")
+
+    def fetch_collaboration_state(self, force: bool = False) -> CollaborationState:
+        """Aggregate everyone's progress; cached between refresh deadlines."""
+        now = get_dht_time()
+        if (
+            not force
+            and self._cached is not None
+            and now < self._cached.next_fetch_time
+        ):
+            return self._cached
+        entry = self.dht.get(self.key, latest=True)
+        max_step, total_samples, total_sps = 0, 0, 0.0
+        num_peers = num_clients = 0
+        if entry is not None and hasattr(entry.value, "items"):
+            records = []
+            for _sk, v in entry.value.items():
+                try:
+                    records.append(LocalProgress.unpack(v.value))
+                except Exception:  # noqa: BLE001 — malformed record
+                    continue
+            if records:
+                max_step = max(r.step for r in records)
+            for r in records:
+                num_peers += 1
+                num_clients += bool(r.client_mode)
+                total_sps += r.samples_per_second
+                if r.step == max_step:
+                    total_samples += r.samples_accumulated
+        eta = (
+            max(0.0, self.target_batch_size - total_samples) / max(total_sps, 1e-9)
+            if num_peers
+            else float("inf")
+        )
+        # adaptive refresh (arguments.py:29-41): poll faster near the step
+        period = min(
+            self.max_refresh_period,
+            max(self.min_refresh_period, eta / 2 if eta != float("inf")
+                else self.default_refresh_period),
+        )
+        self._cached = CollaborationState(
+            optimizer_step=max_step,
+            samples_accumulated=total_samples,
+            target_batch_size=self.target_batch_size,
+            num_peers=num_peers,
+            num_clients=num_clients,
+            eta_next_step=eta,
+            next_fetch_time=now + period,
+        )
+        return self._cached

@@ -1,0 +1,394 @@
+"""Averaging layer tests: partitioning, in-process group all-reduce,
+matchmaking under races, averager facade over threaded DHTs."""
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+from dedloc_tpu.averaging.allreduce import AllreduceFailed, GroupAllReduce
+from dedloc_tpu.averaging.matchmaking import Matchmaking
+from dedloc_tpu.averaging.partition import (
+    flatten_tree,
+    partition_weighted,
+    unflatten_tree,
+)
+from dedloc_tpu.core.serialization import CompressionType
+from dedloc_tpu.dht.node import DHTNode
+from dedloc_tpu.dht.protocol import RPCClient, RPCServer
+
+
+# ------------------------------------------------------------- partitioning
+
+
+def test_partition_weighted_proportional():
+    spans = partition_weighted(1000, [3.0, 1.0])
+    assert spans == [(0, 750), (750, 1000)]
+
+
+def test_partition_weighted_exact_cover():
+    for total in (0, 1, 7, 1000, 12345):
+        for bw in ([1], [1, 1, 1], [5, 0, 2], [0, 0], [0.3, 0.7, 0.11]):
+            spans = partition_weighted(total, bw)
+            assert spans[0][0] == 0 and spans[-1][1] == total
+            for (a, b), (c, d) in zip(spans, spans[1:]):
+                assert b == c and a <= b and c <= d
+
+
+def test_partition_zero_bandwidth_peer_hosts_nothing():
+    spans = partition_weighted(100, [1.0, 0.0, 1.0])
+    assert spans[1][0] == spans[1][1]
+
+
+def test_flatten_unflatten_roundtrip(rng):
+    tree = {
+        "b/w": rng.standard_normal((3, 4)).astype(np.float32),
+        "a/k": rng.standard_normal((5,)).astype(np.float64),
+        "c": np.array(2.5, np.float32),
+    }
+    flat, spec = flatten_tree(tree)
+    assert flat.dtype == np.float32
+    out = unflatten_tree(flat, spec)
+    assert set(out) == set(tree)
+    for k in tree:
+        np.testing.assert_allclose(out[k], tree[k], rtol=1e-6)
+        assert out[k].dtype == tree[k].dtype and out[k].shape == tree[k].shape
+
+
+# ---------------------------------------------------------------- allreduce
+
+
+async def _allreduce_swarm(vectors, weights, bandwidths, client_mask=None,
+                           compression=CompressionType.NONE):
+    """Run a full group all-reduce among n in-process peers; returns results."""
+    n = len(vectors)
+    client_mask = client_mask or [False] * n
+    servers, clients, reducers, endpoints = [], [], [], []
+    for i in range(n):
+        client = RPCClient(request_timeout=10.0)
+        server = None
+        if not client_mask[i]:
+            server = RPCServer("127.0.0.1", 0)
+            await server.start()
+        clients.append(client)
+        servers.append(server)
+        reducers.append(GroupAllReduce(client, server, compression=compression,
+                                       timeout=10.0))
+        endpoints.append(("127.0.0.1", server.port) if server else None)
+    eff_bw = [0.0 if client_mask[i] else bandwidths[i] for i in range(n)]
+    try:
+        results = await asyncio.gather(
+            *(
+                reducers[i].run("round1", i, vectors[i], weights[i], endpoints,
+                                eff_bw)
+                for i in range(n)
+            )
+        )
+        return results
+    finally:
+        for c in clients:
+            await c.close()
+        for s in servers:
+            if s:
+                await s.stop()
+
+
+def test_allreduce_exact_weighted_mean(rng):
+    n, dim = 4, 1000
+    vectors = [rng.standard_normal(dim).astype(np.float32) for _ in range(n)]
+    weights = [1.0, 2.0, 3.0, 4.0]
+    expected = sum(w * v for w, v in zip(weights, vectors)) / sum(weights)
+    results = asyncio.run(
+        _allreduce_swarm(vectors, weights, [1.0] * n)
+    )
+    for r in results:
+        np.testing.assert_allclose(r, expected, atol=1e-5)
+
+
+def test_allreduce_bandwidth_weighted_spans(rng):
+    n, dim = 3, 999
+    vectors = [rng.standard_normal(dim).astype(np.float32) for _ in range(n)]
+    results = asyncio.run(
+        _allreduce_swarm(vectors, [1.0] * n, [5.0, 1.0, 1.0])
+    )
+    expected = sum(vectors) / n
+    for r in results:
+        np.testing.assert_allclose(r, expected, atol=1e-5)
+
+
+def test_allreduce_fp16_compression(rng):
+    n, dim = 3, 512
+    vectors = [rng.standard_normal(dim).astype(np.float32) for _ in range(n)]
+    results = asyncio.run(
+        _allreduce_swarm(vectors, [1.0] * n, [1.0] * n,
+                         compression=CompressionType.FLOAT16)
+    )
+    expected = sum(vectors) / n
+    for r in results:
+        np.testing.assert_allclose(r, expected, atol=5e-3)
+
+
+def test_allreduce_aux_peer(rng):
+    """weight=0 peer (run_aux.py role): hosts a span, contributes no data."""
+    n, dim = 3, 600
+    vectors = [rng.standard_normal(dim).astype(np.float32) for _ in range(n)]
+    weights = [2.0, 1.0, 0.0]
+    expected = (2 * vectors[0] + vectors[1]) / 3.0
+    results = asyncio.run(_allreduce_swarm(vectors, weights, [1.0] * n))
+    for r in results:
+        np.testing.assert_allclose(r, expected, atol=1e-5)
+
+
+def test_allreduce_client_mode_peer(rng):
+    """bandwidth=0 / no server peer: sends data, hosts nothing, pulls result."""
+    n, dim = 3, 600
+    vectors = [rng.standard_normal(dim).astype(np.float32) for _ in range(n)]
+    results = asyncio.run(
+        _allreduce_swarm(vectors, [1.0] * n, [1.0] * n,
+                         client_mask=[False, False, True])
+    )
+    expected = sum(vectors) / n
+    for r in results:
+        np.testing.assert_allclose(r, expected, atol=1e-5)
+
+
+def test_allreduce_dead_sender_tolerated(rng):
+    """A dead SENDER (client-mode, hosts nothing) is dropped after the
+    straggler window; surviving members still complete consistently."""
+
+    async def run():
+        n, dim = 3, 300
+        vectors = [np.ones(dim, np.float32) * (i + 1) for i in range(n)]
+        servers, clients, reducers, endpoints = [], [], [], []
+        for i in range(n):
+            client = RPCClient(request_timeout=10.0)
+            server = None
+            if i != 2:  # member 2 is client-mode (no server, bandwidth 0)
+                server = RPCServer("127.0.0.1", 0)
+                await server.start()
+            clients.append(client)
+            servers.append(server)
+            reducers.append(
+                GroupAllReduce(client, server, timeout=10.0,
+                               straggler_timeout=0.5)
+            )
+            endpoints.append(("127.0.0.1", server.port) if server else None)
+        bw = [1.0, 1.0, 0.0]
+        try:
+            # member 2 never calls run() — dead sender
+            results = await asyncio.gather(
+                reducers[0].run("r", 0, vectors[0], 1.0, endpoints, bw),
+                reducers[1].run("r", 1, vectors[1], 1.0, endpoints, bw),
+            )
+            expected = (vectors[0] + vectors[1]) / 2  # straggler excluded
+            for r in results:
+                np.testing.assert_allclose(r, expected, atol=1e-5)
+        finally:
+            for c in clients:
+                await c.close()
+            for s in servers:
+                if s:
+                    await s.stop()
+
+    asyncio.run(run())
+
+
+def test_allreduce_dead_member_fails_round(rng):
+    """A member that never sends its parts must fail the round for hosts
+    expecting it — within the timeout, not a hang."""
+
+    async def run():
+        n, dim = 3, 300
+        vectors = [np.ones(dim, np.float32) * i for i in range(n)]
+        servers, clients, reducers, endpoints = [], [], [], []
+        for i in range(n):
+            client = RPCClient(request_timeout=2.0)
+            server = RPCServer("127.0.0.1", 0)
+            await server.start()
+            clients.append(client)
+            servers.append(server)
+            reducers.append(
+                GroupAllReduce(client, server, timeout=2.0)
+            )
+            endpoints.append(("127.0.0.1", server.port))
+        try:
+            # peer 2 never calls run() — it's dead
+            results = await asyncio.gather(
+                reducers[0].run("r", 0, vectors[0], 1.0, endpoints, [1.0] * n),
+                reducers[1].run("r", 1, vectors[1], 1.0, endpoints, [1.0] * n),
+                return_exceptions=True,
+            )
+            assert all(isinstance(r, AllreduceFailed) for r in results)
+        finally:
+            for c in clients:
+                await c.close()
+            for s in servers:
+                await s.stop()
+
+    asyncio.run(run())
+
+
+# -------------------------------------------------------------- matchmaking
+
+
+async def _mm_swarm(n, averaging_expiration=1.0, target_group_size=256):
+    """n DHT nodes + matchmakers in one loop."""
+    first = await DHTNode.create(listen_host="127.0.0.1")
+    nodes = [first] + [
+        await DHTNode.create(listen_host="127.0.0.1",
+                             initial_peers=[first.endpoint])
+        for _ in range(n - 1)
+    ]
+    mms = []
+    servers, clients = [], []
+    for node in nodes:
+        client = RPCClient(request_timeout=10.0)
+        server = RPCServer("127.0.0.1", 0)
+        await server.start()
+        clients.append(client)
+        servers.append(server)
+        mms.append(
+            Matchmaking(
+                node, client, server, "test", node.node_id.to_bytes(),
+                ("127.0.0.1", server.port), bandwidth=1.0,
+                target_group_size=target_group_size,
+                averaging_expiration=averaging_expiration,
+            )
+        )
+    return nodes, mms, servers, clients
+
+
+async def _mm_teardown(nodes, servers, clients):
+    for c in clients:
+        await c.close()
+    for s in servers:
+        await s.stop()
+    for node in nodes:
+        await node.shutdown()
+
+
+def test_matchmaking_converges_to_groups():
+    async def run():
+        nodes, mms, servers, clients = await _mm_swarm(4)
+        try:
+            # peers arrive staggered, as they would in reality
+            async def form(i):
+                await asyncio.sleep(i * 0.1)
+                return await mms[i].form_group("step7")
+
+            groups = await asyncio.gather(*(form(i) for i in range(4)))
+            # everyone lands in a group; members agree on membership
+            by_leader = {}
+            for g in groups:
+                by_leader.setdefault(g.members[0].peer_id, []).append(g)
+            for leader, gs in by_leader.items():
+                ids0 = [m.peer_id for m in gs[0].members]
+                for g in gs[1:]:
+                    assert [m.peer_id for m in g.members] == ids0
+            # group sizes sum to 4
+            sizes = {g.members[0].peer_id: len(g.members) for g in groups}
+            assert sum(sizes.values()) == 4 or sum(sizes.values()) >= 4
+            # ideally one group forms when all arrive within expiration
+            assert max(len(g.members) for g in groups) >= 2
+        finally:
+            await _mm_teardown(nodes, servers, clients)
+
+    asyncio.run(run())
+
+
+def test_matchmaking_respects_group_size_cap():
+    async def run():
+        nodes, mms, servers, clients = await _mm_swarm(
+            5, target_group_size=2, averaging_expiration=1.0
+        )
+        try:
+            groups = await asyncio.gather(
+                *(mms[i].form_group("roundX") for i in range(5))
+            )
+            assert all(len(g.members) <= 2 for g in groups)
+        finally:
+            await _mm_teardown(nodes, servers, clients)
+
+    asyncio.run(run())
+
+
+def test_matchmaking_solo_peer_gets_singleton():
+    async def run():
+        nodes, mms, servers, clients = await _mm_swarm(1, averaging_expiration=0.3)
+        try:
+            g = await mms[0].form_group("alone")
+            assert len(g.members) == 1 and g.my_index == 0
+        finally:
+            await _mm_teardown(nodes, servers, clients)
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------- averager end-to-end
+
+
+def test_decentralized_averager_end_to_end(rng):
+    """Two averagers over threaded DHT facades: gradients averaged exactly."""
+    from dedloc_tpu.averaging import DecentralizedAverager
+    from dedloc_tpu.dht import DHT
+
+    first = DHT(start=True, listen_host="127.0.0.1")
+    second = DHT(start=True, listen_host="127.0.0.1",
+                 initial_peers=[first.get_visible_address()])
+    try:
+        avg1 = DecentralizedAverager(first, "exp", averaging_expiration=1.0,
+                                     averaging_timeout=10.0,
+                                     listen_host="127.0.0.1")
+        avg2 = DecentralizedAverager(second, "exp", averaging_expiration=1.0,
+                                     averaging_timeout=10.0,
+                                     listen_host="127.0.0.1")
+        t1 = {"w": np.ones((10,), np.float32), "b": np.zeros((2,), np.float32)}
+        t2 = {"w": np.zeros((10,), np.float32), "b": np.ones((2,), np.float32)}
+
+        out = {}
+
+        def run1():
+            out[1] = avg1.step(t1, weight=1.0, round_id="g1")
+
+        def run2():
+            out[2] = avg2.step(t2, weight=3.0, round_id="g1")
+
+        th1 = threading.Thread(target=run1)
+        th2 = threading.Thread(target=run2)
+        th1.start(); th2.start()
+        th1.join(timeout=30); th2.join(timeout=30)
+        assert 1 in out and 2 in out
+        r1, size1 = out[1]
+        r2, size2 = out[2]
+        assert size1 == 2 and size2 == 2
+        expected_w = (1 * 1.0 + 0 * 3.0) / 4.0
+        expected_b = (0 * 1.0 + 1 * 3.0) / 4.0
+        np.testing.assert_allclose(r1["w"], expected_w, atol=5e-3)
+        np.testing.assert_allclose(r2["b"], expected_b, atol=5e-3)
+        np.testing.assert_allclose(r1["w"], r2["w"], atol=5e-3)
+    finally:
+        avg1.shutdown(); avg2.shutdown()
+        second.shutdown(); first.shutdown()
+
+
+def test_averager_state_sharing():
+    from dedloc_tpu.averaging import DecentralizedAverager
+    from dedloc_tpu.dht import DHT
+
+    first = DHT(start=True, listen_host="127.0.0.1")
+    second = DHT(start=True, listen_host="127.0.0.1",
+                 initial_peers=[first.get_visible_address()])
+    try:
+        provider = DecentralizedAverager(first, "exp2", listen_host="127.0.0.1")
+        joiner = DecentralizedAverager(second, "exp2", listen_host="127.0.0.1")
+        tree = {"p": np.arange(5, dtype=np.float32)}
+        provider.set_shared_state(tree, {"step": 123})
+        provider.publish_state_provider()
+        result = joiner.load_state_from_peers()
+        assert result is not None
+        metadata, fetched = result
+        assert metadata["step"] == 123
+        np.testing.assert_array_equal(fetched["p"], tree["p"])
+    finally:
+        provider.shutdown(); joiner.shutdown()
+        second.shutdown(); first.shutdown()

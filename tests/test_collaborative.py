@@ -1,0 +1,271 @@
+"""End-to-end collaborative training: several peers (threads, each with its
+own DHT + averager) jointly emulate one large-batch synchronous run — the
+core DeDLOC capability (SURVEY.md §0)."""
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dedloc_tpu.collaborative import CollaborativeOptimizer
+from dedloc_tpu.dht import DHT
+from dedloc_tpu.optim import lamb
+from dedloc_tpu.parallel import TrainState, make_accumulate_step
+from dedloc_tpu.parallel.train_step import zeros_like_grads
+
+
+def _toy_loss(params, batch, rng):
+    pred = batch["x"] @ params["w"]
+    loss = jnp.mean((pred - batch["y"]) ** 2)
+    return loss, {"loss": loss}
+
+
+def _make_problem(seed):
+    k = jax.random.PRNGKey(seed)
+    w_true = jnp.array([[1.0], [-2.0]])
+    x = jax.random.normal(k, (16, 2))
+    return {"x": x, "y": x @ w_true}
+
+
+def _opt_kwargs(**over):
+    kw = dict(
+        target_batch_size=64,
+        averaging_expiration=1.5,
+        averaging_timeout=15.0,
+        min_refresh_period=0.1,
+        default_refresh_period=0.3,
+        listen_host="127.0.0.1",
+    )
+    kw.update(over)
+    return kw
+
+
+def test_two_peers_converge_identically():
+    """Both peers reach the global batch together, average grads, and end the
+    round with IDENTICAL parameters (exact synchronous-SGD emulation)."""
+    first_dht = DHT(start=True, listen_host="127.0.0.1")
+    second_dht = DHT(start=True, listen_host="127.0.0.1",
+                     initial_peers=[first_dht.get_visible_address()])
+    tx = lamb(0.05, weight_decay=0.0)
+    results = {}
+    errors = []
+
+    def peer(idx, dht, seed):
+        try:
+            opt = CollaborativeOptimizer(tx, dht, "toy", **_opt_kwargs())
+            params = {"w": jnp.array([[0.5], [0.5]])}
+            state = TrainState.create(params, tx)
+            acc_fn = make_accumulate_step(_toy_loss)
+            batch = _make_problem(seed)
+            grad_acc = zeros_like_grads(params)
+            n_acc = jnp.zeros([], jnp.int32)
+            stepped = False
+            deadline = time.time() + 60
+            while not stepped and time.time() < deadline:
+                grad_acc, n_acc, _ = acc_fn(
+                    state.params, grad_acc, n_acc, batch, jax.random.PRNGKey(0)
+                )
+                state, grad_acc, n_acc, stepped = opt.step(
+                    state, grad_acc, n_acc, samples=16
+                )
+            results[idx] = (jax.device_get(state.params), opt)
+            assert stepped, f"peer {idx} never performed a global step"
+        except Exception as e:  # noqa: BLE001
+            errors.append((idx, e))
+
+    t1 = threading.Thread(target=peer, args=(0, first_dht, 0))
+    t2 = threading.Thread(target=peer, args=(1, second_dht, 1))
+    t1.start(); t2.start()
+    t1.join(timeout=90); t2.join(timeout=90)
+    try:
+        assert not errors, errors
+        assert set(results) == {0, 1}
+        p0, opt0 = results[0]
+        p1, opt1 = results[1]
+        # the whole point: after a group round both peers hold the SAME params
+        np.testing.assert_allclose(p0["w"], p1["w"], atol=1e-4)
+        assert opt0.local_step == 1 and opt1.local_step == 1
+        assert opt0.averager.last_group_size == 2
+    finally:
+        for _, opt in results.values():
+            opt.shutdown()
+        second_dht.shutdown(); first_dht.shutdown()
+
+
+def test_solo_peer_steps_locally():
+    """A single peer collaboration still works (group of one)."""
+    dht = DHT(start=True, listen_host="127.0.0.1")
+    tx = lamb(0.05, weight_decay=0.0)
+    opt = CollaborativeOptimizer(
+        tx, dht, "solo", **_opt_kwargs(target_batch_size=32,
+                                       averaging_expiration=0.3)
+    )
+    try:
+        params = {"w": jnp.array([[0.5], [0.5]])}
+        state = TrainState.create(params, tx)
+        acc_fn = make_accumulate_step(_toy_loss)
+        batch = _make_problem(0)
+        grad_acc = zeros_like_grads(params)
+        n_acc = jnp.zeros([], jnp.int32)
+        steps = 0
+        deadline = time.time() + 60
+        while steps < 2 and time.time() < deadline:
+            grad_acc, n_acc, _ = acc_fn(
+                state.params, grad_acc, n_acc, batch, jax.random.PRNGKey(0)
+            )
+            state, grad_acc, n_acc, stepped = opt.step(
+                state, grad_acc, n_acc, samples=16
+            )
+            steps += stepped
+        assert steps == 2
+        assert opt.local_step == 2
+        assert int(state.step) == 2
+    finally:
+        opt.shutdown()
+        dht.shutdown()
+
+
+def test_late_joiner_catches_up():
+    """A peer joining after N global steps pulls state from peers instead of
+    training from scratch (run_trainer.py:124-128)."""
+    first_dht = DHT(start=True, listen_host="127.0.0.1")
+    tx = lamb(0.05, weight_decay=0.0)
+    opt1 = CollaborativeOptimizer(
+        tx, first_dht, "late", **_opt_kwargs(target_batch_size=32,
+                                             averaging_expiration=0.3)
+    )
+    try:
+        params = {"w": jnp.array([[0.5], [0.5]])}
+        state = TrainState.create(params, tx)
+        acc_fn = make_accumulate_step(_toy_loss)
+        batch = _make_problem(0)
+        grad_acc = zeros_like_grads(params)
+        n_acc = jnp.zeros([], jnp.int32)
+        steps = 0
+        while steps < 3:
+            grad_acc, n_acc, _ = acc_fn(
+                state.params, grad_acc, n_acc, batch, jax.random.PRNGKey(0)
+            )
+            state, grad_acc, n_acc, stepped = opt1.step(
+                state, grad_acc, n_acc, samples=16
+            )
+            steps += stepped
+
+        # late joiner
+        second_dht = DHT(start=True, listen_host="127.0.0.1",
+                         initial_peers=[first_dht.get_visible_address()])
+        opt2 = CollaborativeOptimizer(tx, second_dht, "late", **_opt_kwargs())
+        fresh = TrainState.create({"w": jnp.array([[0.0], [0.0]])}, tx)
+        caught_up = opt2.load_state_from_peers(fresh)
+        np.testing.assert_allclose(
+            jax.device_get(caught_up.params)["w"],
+            jax.device_get(state.params)["w"],
+            atol=1e-6,
+        )
+        assert opt2.local_step == opt1.local_step
+        assert int(caught_up.step) == int(state.step)
+        opt2.shutdown()
+        second_dht.shutdown()
+    finally:
+        opt1.shutdown()
+        first_dht.shutdown()
+
+
+def test_nan_guard_rolls_back():
+    """Non-finite gradients must not destroy the model (run_trainer.py:134)."""
+    dht = DHT(start=True, listen_host="127.0.0.1")
+    tx = lamb(0.05, weight_decay=0.0)
+    opt = CollaborativeOptimizer(
+        tx, dht, "nanex", **_opt_kwargs(target_batch_size=16,
+                                        averaging_expiration=0.3)
+    )
+    try:
+        params = {"w": jnp.array([[0.5], [0.5]])}
+        state = TrainState.create(params, tx)
+        acc_fn = make_accumulate_step(_toy_loss)
+        batch = _make_problem(0)
+        grad_acc = zeros_like_grads(params)
+        n_acc = jnp.zeros([], jnp.int32)
+        # one clean step to establish a backup
+        stepped = False
+        while not stepped:
+            grad_acc, n_acc, _ = acc_fn(
+                state.params, grad_acc, n_acc, batch, jax.random.PRNGKey(0)
+            )
+            state, grad_acc, n_acc, stepped = opt.step(
+                state, grad_acc, n_acc, samples=16
+            )
+        good = jax.device_get(state.params)["w"]
+        # now poison the accumulator (re-poison until the round fires)
+        stepped = False
+        deadline = time.time() + 60
+        while not stepped and time.time() < deadline:
+            grad_acc = {"w": jnp.full_like(grad_acc["w"], jnp.nan)}
+            n_acc = jnp.ones([], jnp.int32)
+            state, grad_acc, n_acc, stepped = opt.step(
+                state, grad_acc, n_acc, samples=16
+            )
+        assert stepped
+        after = jax.device_get(state.params)["w"]
+        assert np.isfinite(after).all()
+        np.testing.assert_allclose(after, good, atol=1e-6)  # rolled back
+    finally:
+        opt.shutdown()
+        dht.shutdown()
+
+
+def test_aux_peer_joins_round():
+    """Aux peer (run_aux.py): no gradients, but participates in averaging."""
+    first_dht = DHT(start=True, listen_host="127.0.0.1")
+    aux_dht = DHT(start=True, listen_host="127.0.0.1",
+                  initial_peers=[first_dht.get_visible_address()])
+    tx = lamb(0.05, weight_decay=0.0)
+    trainer_opt = CollaborativeOptimizer(
+        tx, first_dht, "auxex", **_opt_kwargs(target_batch_size=32,
+                                              averaging_expiration=1.5)
+    )
+    aux_opt = CollaborativeOptimizer(
+        tx, aux_dht, "auxex", auxiliary=True,
+        **_opt_kwargs(target_batch_size=32, averaging_expiration=1.5),
+    )
+    results = {}
+
+    def trainer():
+        params = {"w": jnp.array([[0.5], [0.5]])}
+        state = TrainState.create(params, tx)
+        acc_fn = make_accumulate_step(_toy_loss)
+        batch = _make_problem(0)
+        grad_acc = zeros_like_grads(params)
+        n_acc = jnp.zeros([], jnp.int32)
+        stepped = False
+        deadline = time.time() + 60
+        while not stepped and time.time() < deadline:
+            grad_acc, n_acc, _ = acc_fn(
+                state.params, grad_acc, n_acc, batch, jax.random.PRNGKey(0)
+            )
+            state, grad_acc, n_acc, stepped = opt_step_result = trainer_opt.step(
+                state, grad_acc, n_acc, samples=16
+            )
+        results["trainer_stepped"] = stepped
+
+    def aux():
+        template = {"['w']": np.zeros((2, 1), np.float32)}
+        deadline = time.time() + 60
+        while "trainer_stepped" not in results and time.time() < deadline:
+            joined = aux_opt.step_aux(template)
+            if joined:
+                results["aux_joined"] = True
+            time.sleep(0.2)
+
+    t1 = threading.Thread(target=trainer)
+    t2 = threading.Thread(target=aux)
+    t1.start(); t2.start()
+    t1.join(timeout=90); t2.join(timeout=90)
+    try:
+        assert results.get("trainer_stepped")
+        assert results.get("aux_joined"), "aux peer never joined a round"
+    finally:
+        trainer_opt.shutdown(); aux_opt.shutdown()
+        aux_dht.shutdown(); first_dht.shutdown()
