@@ -36,7 +36,7 @@ from dedloc_tpu.core.serialization import (
     serialize_array,
 )
 from dedloc_tpu.averaging.partition import partition_weighted
-from dedloc_tpu.dht.protocol import Endpoint, RPCClient, RPCServer
+from dedloc_tpu.dht.protocol import Endpoint, RPCClient, RPCError, RPCServer
 from dedloc_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -132,7 +132,10 @@ class GroupAllReduce:
         """
         n = len(endpoints)
         assert 0 <= my_index < n
-        spans = partition_weighted(len(vector), list(bandwidths))
+        can_host = [ep is not None for ep in endpoints]
+        if not any(can_host):
+            raise AllreduceFailed(f"round {round_id}: no member can host a span")
+        spans = partition_weighted(len(vector), list(bandwidths), can_host)
         # every member announces itself to every host — auxiliary peers send a
         # zero-weight marker instead of data, so hosts know not to wait
         senders = set(range(n))
@@ -153,7 +156,10 @@ class GroupAllReduce:
                 ),
                 timeout=self.timeout,
             )
-        except (asyncio.TimeoutError, ConnectionError, OSError) as e:
+        except (asyncio.TimeoutError, ConnectionError, OSError, RPCError) as e:
+            # RPCError covers remote-side failures (a host whose handler timed
+            # out or crashed replies ok=False) — a failed round must cost one
+            # round, not the training process
             raise AllreduceFailed(f"round {round_id}: {e!r}") from e
         finally:
             # deferred cleanup: slower members may still pull our reduced span
@@ -220,7 +226,8 @@ class GroupAllReduce:
                 reduced = acc / total_w
             else:  # all-aux group: nothing to average
                 reduced = vector[lo:hi].astype(np.float32)
-            my_state.reduced.set_result((reduced, total_w))
+            if not my_state.reduced.done():
+                my_state.reduced.set_result((reduced, total_w))
 
         # 3) gather all reduced spans
         async def fetch(j: int) -> np.ndarray:

@@ -146,8 +146,10 @@ class DecentralizedAverager:
             return (tree if weight > 0 else None), 1
         flat, spec = flatten_tree(tree)
         try:
+            # the nonce is fresh per group assembly, so a retried round never
+            # collides with _RoundState left over from a failed attempt
             averaged = await self.allreduce.run(
-                f"{self.prefix}:{round_id}:{group.members[0].peer_id.hex()[:8]}",
+                f"{self.prefix}:{round_id}:{group.nonce}",
                 group.my_index,
                 flat,
                 weight,
@@ -182,11 +184,19 @@ class DecentralizedAverager:
             raise FileNotFoundError("no state snapshot available yet")
         if blob is None:
             tree, metadata = snapshot
-            blob = pack_obj(
-                {
-                    "metadata": pack_obj(metadata),
-                    "tree": serialize_tree(tree, CompressionType.NONE),
-                }
+
+            def _serialize() -> bytes:
+                return pack_obj(
+                    {
+                        "metadata": pack_obj(metadata),
+                        "tree": serialize_tree(tree, CompressionType.NONE),
+                    }
+                )
+
+            # off the event loop: serializing the full model+optimizer state
+            # can take seconds and must not stall live matchmaking/allreduce
+            blob = await asyncio.get_running_loop().run_in_executor(
+                None, _serialize
             )
             with self._state_lock:
                 if self._shared_state is snapshot:  # not replaced meanwhile

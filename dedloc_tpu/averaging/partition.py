@@ -8,7 +8,7 @@ size parts — they contribute data but never host a reduction.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -39,15 +39,30 @@ def unflatten_tree(
     return out
 
 
-def partition_weighted(total_size: int, bandwidths: Sequence[float]) -> List[Tuple[int, int]]:
+def partition_weighted(
+    total_size: int,
+    bandwidths: Sequence[float],
+    can_host: Optional[Sequence[bool]] = None,
+) -> List[Tuple[int, int]]:
     """Split [0, total_size) into len(bandwidths) contiguous spans with sizes
-    proportional to bandwidth (largest-remainder rounding; exact cover)."""
+    proportional to bandwidth (largest-remainder rounding; exact cover).
+
+    ``can_host[i] == False`` forces span i empty regardless of bandwidth —
+    used for client-mode members that cannot accept inbound connections. The
+    all-zero-bandwidth fallback distributes only among hosting-capable
+    members for the same reason."""
     n = len(bandwidths)
     assert n > 0
+    hostable = (
+        np.ones(n, dtype=bool)
+        if can_host is None
+        else np.asarray(list(can_host), dtype=bool)
+    )
+    assert hostable.any(), "at least one member must be able to host"
     bw = np.asarray(bandwidths, dtype=np.float64)
-    bw = np.where(np.isfinite(bw) & (bw > 0), bw, 0.0)
+    bw = np.where(np.isfinite(bw) & (bw > 0) & hostable, bw, 0.0)
     if bw.sum() <= 0:
-        bw = np.ones(n)
+        bw = hostable.astype(np.float64)
     ideal = bw / bw.sum() * total_size
     sizes = np.floor(ideal).astype(np.int64)
     remainder = int(total_size - sizes.sum())

@@ -137,6 +137,7 @@ class CollaborativeOptimizer:
             expected_drift_rate=expected_drift_rate,
         )
         self.performance_ema = PerformanceEMA(alpha=performance_ema_alpha)
+        self._ema_started = False
         self.local_step = 0
         self.local_samples_accumulated = 0
         self._apply_fn = make_apply_step(tx, mesh=mesh)
@@ -171,10 +172,15 @@ class CollaborativeOptimizer:
         assert not self.auxiliary, "auxiliary peers must use step_aux()"
         with self._lock:
             self.local_samples_accumulated += samples
-            if self.performance_ema.num_updates == 0:
-                # ignore compile time in throughput stats
+            if self._ema_started:
+                self.performance_ema.update(samples)
+            else:
+                # first call: start the clock only — measuring from resume()
+                # to now would seed the EMA with a near-zero interval and
+                # publish absurd samples/sec to the DHT (and this also keeps
+                # compile time out of throughput stats)
                 self.performance_ema.resume()
-            self.performance_ema.update(samples)
+                self._ema_started = True
 
             collab = self.tracker.fetch_collaboration_state()
             if collab.optimizer_step > self.local_step or self._desynced:

@@ -40,6 +40,21 @@ def test_partition_zero_bandwidth_peer_hosts_nothing():
     assert spans[1][0] == spans[1][1]
 
 
+def test_partition_all_zero_bandwidth_respects_can_host():
+    # regression: the equal-split fallback must not hand a span to a
+    # client-mode member that cannot accept inbound connections
+    spans = partition_weighted(100, [0.0, 0.0, 0.0], can_host=[True, False, True])
+    assert spans[1][0] == spans[1][1]
+    assert spans[0][1] - spans[0][0] == 50
+    assert spans[2][1] - spans[2][0] == 50
+
+
+def test_partition_can_host_overrides_bandwidth():
+    spans = partition_weighted(90, [1.0, 1.0, 1.0], can_host=[True, False, True])
+    assert spans[1][0] == spans[1][1]
+    assert sum(b - a for a, b in spans) == 90
+
+
 def test_flatten_unflatten_roundtrip(rng):
     tree = {
         "b/w": rng.standard_normal((3, 4)).astype(np.float32),
