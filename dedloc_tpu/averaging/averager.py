@@ -74,6 +74,7 @@ class DecentralizedAverager:
         self._shared_state: Optional[Tuple[Dict[str, np.ndarray], Dict[str, Any]]] = None
         self._shared_state_blob: Optional[bytes] = None
         self._state_lock = threading.Lock()
+        self._serialize_task: Optional[asyncio.Task] = None
         self.server: Optional[RPCServer] = None
         self.endpoint = None
         self.last_group_size: int = 1
@@ -193,11 +194,15 @@ class DecentralizedAverager:
                     }
                 )
 
-            # off the event loop: serializing the full model+optimizer state
-            # can take seconds and must not stall live matchmaking/allreduce
-            blob = await asyncio.get_running_loop().run_in_executor(
-                None, _serialize
-            )
+            # off the event loop (serializing the full model+optimizer state
+            # can take seconds and must not stall live matchmaking/allreduce),
+            # and deduplicated: concurrent late joiners await ONE serialization
+            if self._serialize_task is None or self._serialize_task.done():
+                loop = asyncio.get_running_loop()
+                self._serialize_task = asyncio.ensure_future(
+                    loop.run_in_executor(None, _serialize)
+                )
+            blob = await asyncio.shield(self._serialize_task)
             with self._state_lock:
                 if self._shared_state is snapshot:  # not replaced meanwhile
                     self._shared_state_blob = blob

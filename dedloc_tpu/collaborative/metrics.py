@@ -1,0 +1,106 @@
+"""The signed metrics bus: per-peer training metrics over the DHT.
+
+Capability parity with albert/metrics_utils.py:9-24: a pydantic
+``LocalMetrics`` schema stored under ``{prefix}_metrics`` with one subkey per
+peer, protected by RSA signature + schema validation so metrics are
+spoof-resistant. The coordinator (roles/coordinator.py) aggregates these the
+same way run_first_peer.py:176-218 does.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from pydantic import BaseModel, StrictFloat, StrictInt, conint
+
+from dedloc_tpu.core.serialization import unpack_obj
+from dedloc_tpu.core.timeutils import get_dht_time
+from dedloc_tpu.dht.crypto import RSAPrivateKey
+from dedloc_tpu.dht.dht import DHT
+from dedloc_tpu.dht.validation import (
+    RecordValidatorBase,
+    RSASignatureValidator,
+    SchemaValidator,
+)
+
+
+class LocalMetrics(BaseModel):
+    """Reference: LocalMetrics(BaseModel) at albert/metrics_utils.py:9-15."""
+
+    step: StrictInt
+    samples_per_second: StrictFloat
+    samples_accumulated: StrictInt
+    loss: StrictFloat
+    mini_steps: StrictInt
+
+
+class MetricSchema(BaseModel):
+    """Shape of the full ``{prefix}_metrics`` dictionary value: one
+    LocalMetrics per signed peer subkey (metrics_utils.py:17-18)."""
+
+    metrics: Dict[str, LocalMetrics]
+
+
+def make_validators(
+    prefix: str, private_key: Optional[RSAPrivateKey] = None
+) -> Tuple[List[RecordValidatorBase], bytes]:
+    """[schema, signature] validator chain + this peer's public-key subkey
+    (metrics_utils.py:21-24)."""
+    signature = RSASignatureValidator(private_key)
+    schema = SchemaValidator({"metrics": LocalMetrics}, prefix=prefix)
+    return [schema, signature], signature.local_public_key
+
+
+def publish_metrics(
+    dht: DHT,
+    prefix: str,
+    subkey: bytes,
+    metrics: LocalMetrics,
+    expiration: float = 600.0,
+) -> None:
+    """Store this peer's metrics (statistics_expiration default matches
+    albert/arguments.py:82-84)."""
+    dht.store(
+        f"{prefix}_metrics",
+        metrics.model_dump(),
+        get_dht_time() + expiration,
+        subkey=subkey,
+        return_future=True,
+    )
+
+
+def fetch_metrics(dht: DHT, prefix: str) -> List[LocalMetrics]:
+    """All currently-live peer metrics (coordinator view,
+    run_first_peer.py:177-187)."""
+    entry = dht.get(f"{prefix}_metrics", latest=True)
+    out: List[LocalMetrics] = []
+    if entry is None or not hasattr(entry.value, "items"):
+        return out
+    for _subkey, v in entry.value.items():
+        try:
+            payload = v.value
+            if isinstance(payload, (bytes, bytearray)):
+                payload = unpack_obj(payload)
+            out.append(LocalMetrics.model_validate(payload))
+        except Exception:  # noqa: BLE001 — skip malformed peer records
+            continue
+    return out
+
+
+def aggregate_metrics(records: List[LocalMetrics]) -> Optional[dict]:
+    """Coordinator aggregation (run_first_peer.py:188-200): alive peers,
+    summed throughput/samples, loss averaged over mini-steps of the CURRENT
+    global step."""
+    if not records:
+        return None
+    current_step = max(m.step for m in records)
+    current = [m for m in records if m.step == current_step]
+    sum_mini = sum(m.mini_steps for m in current)
+    sum_loss = sum(m.loss for m in current)
+    return {
+        "step": current_step,
+        "alive_peers": len(records),
+        "samples_accumulated": sum(m.samples_accumulated for m in current),
+        "samples_per_second": sum(m.samples_per_second for m in records),
+        "loss": (sum_loss / sum_mini) if sum_mini else 0.0,
+        "mini_steps": sum_mini,
+    }

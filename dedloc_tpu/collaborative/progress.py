@@ -81,10 +81,13 @@ class ProgressTracker:
         self.metadata_expiration = metadata_expiration
         self.expected_drift_peers = expected_drift_peers
         self.expected_drift_rate = expected_drift_rate
-        self._cached: Optional[CollaborationState] = None
+        self._records: Optional[dict] = None  # subkey -> LocalProgress (DHT view)
+        self._next_fetch: float = 0.0
+        self._last_local: Optional[LocalProgress] = None
 
     def report_local_progress(self, progress: LocalProgress) -> None:
         """Fire-and-forget publish of this peer's accumulation state."""
+        self._last_local = progress
         try:
             self.dht.store(
                 self.key,
@@ -97,50 +100,66 @@ class ProgressTracker:
             logger.debug(f"progress report failed: {e!r}")
 
     def fetch_collaboration_state(self, force: bool = False) -> CollaborationState:
-        """Aggregate everyone's progress; cached between refresh deadlines."""
+        """Aggregate everyone's progress.
+
+        Remote records are cache-gated by the adaptive refresh period, but
+        this peer's OWN latest progress is overlaid on every call — like the
+        reference, a peer that accumulates the whole target batch by itself
+        becomes ready_for_step immediately, without waiting for its own DHT
+        write to round-trip or the refresh deadline to pass."""
         now = get_dht_time()
-        if (
-            not force
-            and self._cached is not None
-            and now < self._cached.next_fetch_time
-        ):
-            return self._cached
-        entry = self.dht.get(self.key, latest=True)
+        fetched = False
+        if force or self._records is None or now >= self._next_fetch:
+            entry = self.dht.get(self.key, latest=True)
+            by_subkey: dict = {}
+            if entry is not None and hasattr(entry.value, "items"):
+                for sk, v in entry.value.items():
+                    try:
+                        by_subkey[sk] = LocalProgress.unpack(v.value)
+                    except Exception:  # noqa: BLE001 — malformed record
+                        continue
+            self._records = by_subkey
+            fetched = True
+
+        by_subkey = dict(self._records)
+        if self._last_local is not None:
+            stored = by_subkey.get(self.peer_subkey)
+            if stored is None or stored.time <= self._last_local.time:
+                by_subkey[self.peer_subkey] = self._last_local
+
+        records = list(by_subkey.values())
         max_step, total_samples, total_sps = 0, 0, 0.0
         num_peers = num_clients = 0
-        if entry is not None and hasattr(entry.value, "items"):
-            records = []
-            for _sk, v in entry.value.items():
-                try:
-                    records.append(LocalProgress.unpack(v.value))
-                except Exception:  # noqa: BLE001 — malformed record
-                    continue
-            if records:
-                max_step = max(r.step for r in records)
-            for r in records:
-                num_peers += 1
-                num_clients += bool(r.client_mode)
-                total_sps += r.samples_per_second
-                if r.step == max_step:
-                    total_samples += r.samples_accumulated
+        if records:
+            max_step = max(r.step for r in records)
+        for r in records:
+            num_peers += 1
+            num_clients += bool(r.client_mode)
+            total_sps += r.samples_per_second
+            if r.step == max_step:
+                total_samples += r.samples_accumulated
+        # throughput below the floor means "not yet measured" (a fresh peer's
+        # EMA), NOT a multi-year ETA — treat the ETA as unknown so the refresh
+        # period falls back to the default instead of pinning at the maximum
         eta = (
-            max(0.0, self.target_batch_size - total_samples) / max(total_sps, 1e-9)
-            if num_peers
+            max(0.0, self.target_batch_size - total_samples) / total_sps
+            if num_peers and total_sps > 1e-6
             else float("inf")
         )
-        # adaptive refresh (arguments.py:29-41): poll faster near the step
-        period = min(
-            self.max_refresh_period,
-            max(self.min_refresh_period, eta / 2 if eta != float("inf")
-                else self.default_refresh_period),
-        )
-        self._cached = CollaborationState(
+        if fetched:
+            # adaptive refresh (arguments.py:29-41): poll faster near the step
+            period = min(
+                self.max_refresh_period,
+                max(self.min_refresh_period, eta / 2 if eta != float("inf")
+                    else self.default_refresh_period),
+            )
+            self._next_fetch = now + period
+        return CollaborationState(
             optimizer_step=max_step,
             samples_accumulated=total_samples,
             target_batch_size=self.target_batch_size,
             num_peers=num_peers,
             num_clients=num_clients,
             eta_next_step=eta,
-            next_fetch_time=now + period,
+            next_fetch_time=self._next_fetch,
         )
-        return self._cached

@@ -165,6 +165,9 @@ class TrainingArguments:
     """Local-step recipe, mirroring AlbertTrainingArguments
     (albert/arguments.py:104-128)."""
 
+    model_size: str = "large"  # tiny (CI fixture) | large
+    dataset_path: str = ""  # tokenized dataset dir; empty = synthetic fixture
+    max_local_steps: int = 0  # stop after N accumulation boundaries (0 = run forever)
     seq_length: int = 512
     per_device_batch_size: int = 4
     gradient_accumulation_steps: int = 2

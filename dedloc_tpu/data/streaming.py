@@ -1,0 +1,105 @@
+"""Streaming dataset combinators: weighted interleave + seeded shuffle buffer.
+
+Capability parity with sahajbert/dataset_streaming.py:98-139: a lazy mix of
+two text sources with probabilities (wiki 23% / oscar 77%), a shuffle buffer
+of 10^4 examples seeded PER PEER (``shuffle_seed = hash(local_public_key) %
+2**31``, sahajbert/run_trainer.py:268-270 — peers must not see identical
+batches), and an infinite wrapper that restarts exhausted sources.
+
+Source-agnostic: combinators take any iterables/factories, so they work over
+HF streaming datasets, local files, or synthetic generators (the §4 fixture
+pattern) without importing `datasets` here.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def peer_shuffle_seed(peer_public_key: bytes) -> int:
+    """Deterministic per-peer seed (run_trainer.py:268-270 capability —
+    stable across runs, unlike Python's salted hash())."""
+    import hashlib
+
+    return int.from_bytes(
+        hashlib.sha256(peer_public_key).digest()[:4], "little"
+    ) % (2**31)
+
+
+def interleave_weighted(
+    sources: Sequence[Iterable[Any]],
+    probabilities: Sequence[float],
+    seed: int = 0,
+) -> Iterator[Any]:
+    """Sample the next example from source i with probability p_i
+    (merge_datasets(probabilities=...) capability, dataset_streaming.py:127).
+    An exhausted source's probability is redistributed to the others."""
+    assert len(sources) == len(probabilities) > 0
+    rng = np.random.default_rng(seed)
+    iters: List[Optional[Iterator[Any]]] = [iter(s) for s in sources]
+    probs = np.asarray(probabilities, np.float64)
+    probs = probs / probs.sum()
+    while any(it is not None for it in iters):
+        live = [i for i, it in enumerate(iters) if it is not None]
+        p = probs[live] / probs[live].sum()
+        choice = int(rng.choice(live, p=p))
+        try:
+            yield next(iters[choice])  # type: ignore[arg-type]
+        except StopIteration:
+            iters[choice] = None
+
+
+class ShuffleBuffer:
+    """Reservoir-style shuffle buffer (buffer_size 10^4 in the reference,
+    dataset_streaming.py:131): fill the buffer, then yield a random slot and
+    replace it with the next upstream example."""
+
+    def __init__(self, buffer_size: int = 10_000, seed: int = 0):
+        self.buffer_size = buffer_size
+        self.seed = seed
+
+    def __call__(self, source: Iterable[Any]) -> Iterator[Any]:
+        rng = np.random.default_rng(self.seed)
+        buf: List[Any] = []
+        for item in source:
+            if len(buf) < self.buffer_size:
+                buf.append(item)
+                continue
+            idx = int(rng.integers(0, len(buf)))
+            yield buf[idx]
+            buf[idx] = item
+        rng.shuffle(buf)
+        yield from buf
+
+
+def repeat_forever(factory: Callable[[], Iterable[Any]]) -> Iterator[Any]:
+    """Infinite stream over a restartable source (WrappedIterableDataset
+    capability, dataset_streaming.py:105-113: training never stops at epoch
+    boundaries; a crashed/exhausted source is simply reopened)."""
+    while True:
+        produced = False
+        try:
+            for item in factory():
+                produced = True
+                yield item
+        except Exception as e:  # noqa: BLE001 — streaming sources flake
+            logger.warning(f"stream source failed ({e!r}); reopening")
+        if not produced:
+            # avoid a hot loop on a permanently-empty source
+            raise RuntimeError("stream source yielded no examples")
+
+
+def batched(source: Iterable[Any], batch_size: int) -> Iterator[List[Any]]:
+    """Group a stream into fixed-size lists (drops the trailing partial)."""
+    it = iter(source)
+    while True:
+        chunk = list(itertools.islice(it, batch_size))
+        if len(chunk) < batch_size:
+            return
+        yield chunk

@@ -1,0 +1,90 @@
+"""Auxiliary peer: donates bandwidth to averaging, contributes no gradients.
+
+Capability parity with albert/run_aux.py:206-263 — a CPU-only peer that
+joins averaging groups with zero weight every 0.5 s
+(``CollaborativeOptimizer(auxiliary=True, allow_state_sharing=False)`` +
+``step_aux()`` loop). It hosts bandwidth-weighted spans during the group
+reduce-scatter, which speeds up rounds for slow GPU/TPU peers.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dedloc_tpu.collaborative.optimizer import (
+    CollaborativeOptimizer,
+    _tree_to_named,
+)
+from dedloc_tpu.core.config import CollaborationArguments, parse_config
+from dedloc_tpu.roles.common import (
+    build_dht,
+    build_model,
+    build_optimizer,
+    force_cpu_if_requested,
+)
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def run_aux(
+    args: CollaborationArguments,
+    poll_interval: float = 0.5,
+    max_iterations: int = 0,
+) -> int:
+    """Returns the number of averaging rounds joined (for tests)."""
+    force_cpu_if_requested()
+    # aux needs only gradient SHAPES, never runs the model
+    cfg, model = build_model(args.training.model_size)
+    seq = min(args.training.seq_length, cfg.max_position_embeddings)
+    params = jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros((1, seq), jnp.int32))["params"],
+        jax.random.PRNGKey(0),
+    )
+    template = {
+        k: np.zeros(v.shape, np.float32)
+        for k, v in _tree_to_named(params).items()
+    }
+
+    tx = build_optimizer(args)
+    dht, _public_key = build_dht(args)
+    logger.info(f"aux peer DHT listening on {dht.port}")
+    opt = CollaborativeOptimizer(
+        tx,
+        dht,
+        prefix=args.dht.experiment_prefix,
+        target_batch_size=args.optimizer.target_batch_size,
+        bandwidth=args.averager.bandwidth,
+        compression=args.averager.compression,
+        target_group_size=args.averager.target_group_size,
+        averaging_expiration=args.averager.averaging_expiration,
+        averaging_timeout=args.averager.averaging_timeout,
+        auxiliary=True,
+        allow_state_sharing=False,
+        verbose=True,
+    )
+    rounds = iterations = 0
+    try:
+        while True:
+            if opt.step_aux(template):
+                rounds += 1
+                logger.info(f"joined averaging round (total {rounds})")
+            iterations += 1
+            if max_iterations and iterations >= max_iterations:
+                break
+            time.sleep(poll_interval)
+    finally:
+        opt.shutdown()
+        dht.shutdown()
+    return rounds
+
+
+def main(argv=None) -> None:
+    run_aux(parse_config(CollaborationArguments, argv))
+
+
+if __name__ == "__main__":
+    main()

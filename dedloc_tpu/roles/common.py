@@ -1,0 +1,117 @@
+"""Shared builders for role entry points: model, optimizer, DHT, data."""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dedloc_tpu.collaborative.metrics import make_validators
+from dedloc_tpu.core.config import CollaborationArguments
+from dedloc_tpu.data.mlm import SpecialTokens, mask_tokens
+from dedloc_tpu.dht.dht import DHT
+from dedloc_tpu.models.albert import (
+    AlbertConfig,
+    AlbertForPreTraining,
+    albert_pretraining_loss,
+)
+from dedloc_tpu.optim import lamb, linear_warmup_linear_decay
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def force_cpu_if_requested() -> None:
+    """Multi-process drives must not contend for the single TPU chip: set
+    DEDLOC_FORCE_CPU=1 in each peer subprocess (the chip is exclusive)."""
+    if os.environ.get("DEDLOC_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+
+
+def build_model(model_size: str) -> Tuple[AlbertConfig, AlbertForPreTraining]:
+    cfg = AlbertConfig.tiny() if model_size == "tiny" else AlbertConfig.large()
+    return cfg, AlbertForPreTraining(cfg)
+
+
+def build_optimizer(args: CollaborationArguments):
+    """LAMB + linear warmup/decay (reference recipe,
+    albert/arguments.py:104-121 via run_trainer.py:73-100)."""
+    schedule = linear_warmup_linear_decay(
+        args.training.learning_rate,
+        warmup_steps=args.training.warmup_steps,
+        total_steps=args.training.total_steps,
+    )
+    return lamb(
+        learning_rate=schedule,
+        weight_decay=args.training.weight_decay,
+        clamp_value=args.training.clamp_value,
+        max_grad_norm=args.training.max_grad_norm,
+    )
+
+
+def build_dht(args: CollaborationArguments, client_mode: Optional[bool] = None):
+    """DHT with the signed-metrics validator chain. Returns (dht, subkey)."""
+    validators, public_key = make_validators(args.dht.experiment_prefix)
+    dht = DHT(
+        initial_peers=args.dht.initial_peers,
+        start=True,
+        listen_host=args.dht.listen_host,
+        listen_port=args.dht.listen_port,
+        client_mode=args.dht.client_mode if client_mode is None else client_mode,
+        record_validators=validators,
+    )
+    return dht, public_key
+
+
+def build_loss_fn(model: AlbertForPreTraining) -> Callable:
+    def loss_fn(params, batch, rng):
+        mlm_logits, sop_logits = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            batch["attention_mask"],
+            batch["token_type_ids"],
+        )
+        return albert_pretraining_loss(
+            mlm_logits, sop_logits, batch["mlm_labels"], batch["sop_labels"]
+        )
+
+    return loss_fn
+
+
+def synthetic_mlm_batches(
+    cfg: AlbertConfig,
+    batch_size: int,
+    seq_length: int,
+    seed: int,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Synthetic fixture stream (SURVEY.md §4 SyntheticImageDataset pattern):
+    random token documents, real masking path. Deterministic per peer seed."""
+    rng = np.random.default_rng(seed)
+    tokens = SpecialTokens(vocab_size=cfg.vocab_size)
+    seq_length = min(seq_length, cfg.max_position_embeddings)
+    while True:
+        ids = rng.integers(
+            tokens.num_reserved, cfg.vocab_size, (batch_size, seq_length)
+        ).astype(np.int32)
+        batch = {
+            "input_ids": ids,
+            "attention_mask": np.ones((batch_size, seq_length), np.int32),
+            "token_type_ids": np.zeros((batch_size, seq_length), np.int32),
+            "special_tokens_mask": np.zeros((batch_size, seq_length), np.int32),
+            "sop_labels": rng.integers(0, 2, (batch_size,)).astype(np.int32),
+        }
+        yield mask_tokens(batch, rng, tokens)
+
+
+def drop_collator_keys(batch: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Keep only what the jitted loss consumes (static arg structure)."""
+    keep = (
+        "input_ids",
+        "attention_mask",
+        "token_type_ids",
+        "mlm_labels",
+        "sop_labels",
+    )
+    return {k: jnp.asarray(batch[k]) for k in keep}

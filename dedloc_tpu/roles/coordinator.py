@@ -1,0 +1,140 @@
+"""Coordinator ("first peer"): DHT root + metrics aggregation + checkpoints.
+
+Capability parity with albert/run_first_peer.py:24-218: starts the DHT other
+peers bootstrap from, never trains; every ``refresh_period`` seconds it
+aggregates the signed per-peer metrics from the DHT (alive peers, summed
+throughput, loss = Σloss/Σmini_steps) and logs them (wandb when available,
+always JSONL — the TPU build's durable equivalent of the wandb dashboard);
+periodically pulls the newest collaboration state from peers and writes a
+local checkpoint (the reference pushes to the HF hub via git,
+run_first_peer.py:123-147 — the upload seam is ``upload_fn``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from dedloc_tpu.averaging.averager import DecentralizedAverager
+from dedloc_tpu.collaborative.metrics import aggregate_metrics, fetch_metrics
+from dedloc_tpu.core.config import CollaborationArguments, parse_config
+from dedloc_tpu.core.timeutils import get_dht_time
+from dedloc_tpu.roles.common import build_dht, force_cpu_if_requested
+from dedloc_tpu.utils.checkpoint import save_checkpoint
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class CoordinatorExtraArguments:
+    """Reference: CoordinatorArguments (run_first_peer.py:24-57)."""
+
+    refresh_period: float = 30.0
+    save_checkpoint_step_interval: int = 5
+    upload_interval: float = 0.0  # seconds; 0 disables state pulls
+    metrics_log_path: str = "coordinator_metrics.jsonl"
+
+
+def run_coordinator(
+    args: CollaborationArguments,
+    extra: Optional[CoordinatorExtraArguments] = None,
+    upload_fn: Optional[Callable[[str, int], None]] = None,
+    max_iterations: int = 0,
+) -> None:
+    """``upload_fn(checkpoint_path, step)`` is the hub-publish seam
+    (run_first_peer.py:123-147's git push); ``max_iterations`` bounds the
+    loop for tests (0 = run forever)."""
+    force_cpu_if_requested()
+    extra = extra or CoordinatorExtraArguments()
+    dht, _public_key = build_dht(args)
+    logger.info(f"coordinator DHT root listening on {dht.port}")
+
+    averager: Optional[DecentralizedAverager] = None
+    if extra.upload_interval > 0:
+        # listens for state only; contributes no gradients and no bandwidth
+        averager = DecentralizedAverager(
+            dht,
+            args.dht.experiment_prefix,
+            client_mode=True,
+            allow_state_sharing=False,
+        )
+
+    wandb_run = _maybe_wandb(args)
+    current_step = -1
+    last_upload = get_dht_time()
+    iterations = 0
+    try:
+        while True:
+            metrics = fetch_metrics(dht, args.dht.experiment_prefix)
+            agg = aggregate_metrics(metrics)
+            if agg is not None and agg["step"] > current_step:
+                current_step = agg["step"]
+                agg["time"] = get_dht_time()
+                logger.info(
+                    f"step {agg['step']}: {agg['alive_peers']} peers, "
+                    f"{agg['samples_per_second']:.1f} samples/s, "
+                    f"loss {agg['loss']:.4f}"
+                )
+                with open(extra.metrics_log_path, "a") as f:
+                    f.write(json.dumps(agg) + "\n")
+                if wandb_run is not None:
+                    wandb_run.log(agg, step=agg["step"])
+
+                if (
+                    averager is not None
+                    and extra.upload_interval > 0
+                    and get_dht_time() - last_upload >= extra.upload_interval
+                ):
+                    _pull_and_save(args, averager, current_step, upload_fn)
+                    last_upload = get_dht_time()
+
+            iterations += 1
+            if max_iterations and iterations >= max_iterations:
+                break
+            time.sleep(extra.refresh_period)
+    finally:
+        if averager is not None:
+            averager.shutdown()
+        dht.shutdown()
+
+
+def _pull_and_save(args, averager, step, upload_fn) -> None:
+    result = averager.load_state_from_peers()
+    if result is None:
+        logger.warning("no state providers yet; skipping checkpoint")
+        return
+    metadata, tree = result
+    path = save_checkpoint(
+        args.training.output_dir,
+        step,
+        tree,
+        metadata=metadata,
+        save_total_limit=args.training.save_total_limit,
+    )
+    logger.info(f"saved collaboration checkpoint {path}")
+    if upload_fn is not None:
+        upload_fn(path, step)
+
+
+def _maybe_wandb(args: CollaborationArguments):
+    if not args.wandb_project:
+        return None
+    try:
+        import wandb  # type: ignore
+
+        return wandb.init(project=args.wandb_project)
+    except Exception as e:  # noqa: BLE001 — wandb genuinely optional
+        logger.warning(f"wandb unavailable ({e!r}); JSONL logging only")
+        return None
+
+
+def main(argv=None) -> None:
+    run_coordinator(parse_config(CollaborationArguments, argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,212 @@
+"""Trainer peer: the canonical collaborative training loop.
+
+Capability parity with albert/run_trainer.py:210-297 — build model + LAMB +
+DHT + CollaborativeOptimizer, resume from the latest local checkpoint, pull
+newer state from peers at start (on_train_begin semantics :124-128), then
+loop: jitted accumulate per micro-batch; at every accumulation boundary hand
+control to the collaborative optimizer (global-step averaging, NaN rollback)
+and publish signed metrics (:130-170).
+
+TPU-native shape: the hot path is ONE jitted accumulate step with a donated
+device-resident grad accumulator; the jit↔Python seam is crossed once per
+accumulation boundary, not per micro-batch (SURVEY.md §7 hard-part b).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dedloc_tpu.collaborative.metrics import LocalMetrics, publish_metrics
+from dedloc_tpu.collaborative.optimizer import CollaborativeOptimizer
+from dedloc_tpu.core.config import CollaborationArguments, parse_config
+from dedloc_tpu.data.streaming import peer_shuffle_seed
+from dedloc_tpu.parallel.train_step import (
+    TrainState,
+    make_accumulate_step,
+    zeros_like_grads,
+)
+from dedloc_tpu.roles.common import (
+    build_dht,
+    build_loss_fn,
+    build_model,
+    build_optimizer,
+    drop_collator_keys,
+    force_cpu_if_requested,
+    synthetic_mlm_batches,
+)
+from dedloc_tpu.utils.checkpoint import load_latest_checkpoint, save_checkpoint
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def run_trainer(args: CollaborationArguments) -> TrainState:
+    force_cpu_if_requested()
+    cfg, model = build_model(args.training.model_size)
+    tx = build_optimizer(args)
+    dht, public_key = build_dht(args)
+    logger.info(f"trainer DHT listening on {dht.port}")
+
+    rng = jax.random.PRNGKey(args.training.seed)
+    seq = min(args.training.seq_length, cfg.max_position_embeddings)
+    init_ids = jnp.zeros((args.training.per_device_batch_size, seq), jnp.int32)
+    params = model.init(rng, init_ids)["params"]
+    state = jax.jit(lambda p: TrainState.create(p, tx))(params)
+
+    # local resume (run_trainer.py:56-70): newest checkpoint* dir wins
+    resumed = load_latest_checkpoint(args.training.output_dir)
+    if resumed is not None:
+        step, tree, _meta = resumed
+        template = jax.device_get((state.params, state.opt_state))
+        params_t, opt_t = _named_to_tree_pair(tree, template)
+        state = state.replace(
+            step=jnp.asarray(step, jnp.int32),
+            params=jax.device_put(params_t),
+            opt_state=jax.device_put(opt_t),
+        )
+        logger.info(f"resumed from local checkpoint at step {step}")
+
+    opt = CollaborativeOptimizer(
+        tx,
+        dht,
+        prefix=args.dht.experiment_prefix,
+        target_batch_size=args.optimizer.target_batch_size,
+        batch_size_per_step=(
+            args.training.per_device_batch_size
+            * args.training.gradient_accumulation_steps
+        ),
+        bandwidth=args.averager.bandwidth,
+        compression=args.averager.compression,
+        target_group_size=args.averager.target_group_size,
+        averaging_expiration=args.averager.averaging_expiration,
+        averaging_timeout=args.averager.averaging_timeout,
+        metadata_expiration=args.averager.metadata_expiration,
+        statistics_expiration=args.optimizer.statistics_expiration,
+        min_refresh_period=args.averager.min_refresh_period,
+        max_refresh_period=args.averager.max_refresh_period,
+        default_refresh_period=args.averager.default_refresh_period,
+        expected_drift_peers=args.averager.expected_drift_peers,
+        expected_drift_rate=args.averager.expected_drift_rate,
+        performance_ema_alpha=args.averager.performance_ema_alpha,
+        client_mode=args.dht.client_mode,
+        verbose=True,
+    )
+    # catch up with the collaboration before training (:124-128)
+    state = opt.load_state_from_peers(state)
+
+    loss_fn = build_loss_fn(model)
+    accumulate = make_accumulate_step(loss_fn)
+    grad_acc = zeros_like_grads(state.params)
+    n_acc = jnp.zeros([], jnp.int32)
+
+    batches = _make_batches(args, cfg, public_key)
+    data_rng = jax.random.PRNGKey(peer_shuffle_seed(public_key))
+
+    loss_sum, mini_steps = 0.0, 0
+    boundary = 0
+    try:
+        while True:
+            # one accumulation boundary = gradient_accumulation_steps micro-batches
+            for _ in range(args.training.gradient_accumulation_steps):
+                batch = drop_collator_keys(next(batches))
+                data_rng, sub = jax.random.split(data_rng)
+                grad_acc, n_acc, metrics = accumulate(
+                    state.params, grad_acc, n_acc, batch, sub
+                )
+                loss_sum += float(metrics["loss"])
+                mini_steps += 1
+
+            samples = (
+                args.training.per_device_batch_size
+                * args.training.gradient_accumulation_steps
+            )
+            state, grad_acc, n_acc, stepped = opt.step(
+                state, grad_acc, n_acc, samples
+            )
+            if stepped:
+                publish_metrics(
+                    dht,
+                    args.dht.experiment_prefix,
+                    public_key,
+                    LocalMetrics(
+                        step=opt.local_step,
+                        samples_per_second=float(
+                            opt.performance_ema.samples_per_second
+                        ),
+                        samples_accumulated=samples,
+                        loss=loss_sum,
+                        mini_steps=mini_steps,
+                    ),
+                    expiration=args.optimizer.statistics_expiration,
+                )
+                logger.info(
+                    f"global step {opt.local_step}: loss "
+                    f"{loss_sum / max(mini_steps, 1):.4f}"
+                )
+                loss_sum, mini_steps = 0.0, 0
+                if (
+                    args.training.save_steps
+                    and opt.local_step % args.training.save_steps == 0
+                ):
+                    _save(args, state, opt.local_step)
+
+            boundary += 1
+            if (
+                args.training.max_local_steps
+                and boundary >= args.training.max_local_steps
+            ):
+                logger.info(f"reached max_local_steps={boundary}; stopping")
+                break
+    finally:
+        opt.shutdown()
+        dht.shutdown()
+    return state
+
+
+def _save(args: CollaborationArguments, state: TrainState, step: int) -> None:
+    from dedloc_tpu.collaborative.optimizer import _tree_to_named
+
+    host = jax.device_get((state.params, state.opt_state))
+    save_checkpoint(
+        args.training.output_dir,
+        step,
+        _tree_to_named(host),
+        metadata={"step": int(state.step), "local_step": step},
+        save_total_limit=args.training.save_total_limit,
+    )
+
+
+def _named_to_tree_pair(named, template):
+    from dedloc_tpu.collaborative.optimizer import _named_to_tree
+
+    return _named_to_tree(named, template)
+
+
+def _make_batches(args: CollaborationArguments, cfg, public_key: bytes):
+    """Synthetic fixture by default; a tokenized-on-disk dataset when
+    ``dataset_path`` is set (tokenize_wikitext103 output layout)."""
+    seed = peer_shuffle_seed(public_key)  # per-peer independent shuffling
+    if not args.training.dataset_path:
+        return synthetic_mlm_batches(
+            cfg,
+            args.training.per_device_batch_size,
+            args.training.seq_length,
+            seed,
+        )
+    from dedloc_tpu.data.disk import tokenized_dataset_batches
+
+    return tokenized_dataset_batches(
+        args.training.dataset_path,
+        cfg,
+        args.training.per_device_batch_size,
+        args.training.seq_length,
+        seed,
+    )
+
+
+def main(argv=None) -> None:
+    run_trainer(parse_config(CollaborationArguments, argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Tests for local checkpoint save/rotate/resume and the metrics bus."""
+import numpy as np
+
+from dedloc_tpu.collaborative.metrics import (
+    LocalMetrics,
+    aggregate_metrics,
+    make_validators,
+)
+from dedloc_tpu.utils.checkpoint import (
+    latest_checkpoint,
+    list_checkpoints,
+    load_latest_checkpoint,
+    save_checkpoint,
+)
+
+
+def _tree(rng, scale=1.0):
+    return {
+        "w": (rng.standard_normal((4, 4)) * scale).astype(np.float32),
+        "b": (rng.standard_normal((4,)) * scale).astype(np.float32),
+    }
+
+
+def test_checkpoint_roundtrip(rng, tmp_path):
+    tree = _tree(rng)
+    save_checkpoint(str(tmp_path), 100, tree, metadata={"step": 100})
+    loaded = load_latest_checkpoint(str(tmp_path))
+    assert loaded is not None
+    step, out, meta = loaded
+    assert step == 100 and meta["step"] == 100
+    for k in tree:
+        np.testing.assert_array_equal(out[k], tree[k])
+
+
+def test_checkpoint_rotation_keeps_limit(rng, tmp_path):
+    for step in (10, 20, 30, 40):
+        save_checkpoint(str(tmp_path), step, _tree(rng), save_total_limit=2)
+    steps = [s for s, _ in list_checkpoints(str(tmp_path))]
+    assert steps == [30, 40]
+
+
+def test_checkpoint_latest_wins(rng, tmp_path):
+    save_checkpoint(str(tmp_path), 5, _tree(rng, 1.0), save_total_limit=None)
+    save_checkpoint(str(tmp_path), 50, _tree(rng, 2.0), save_total_limit=None)
+    step, _path = latest_checkpoint(str(tmp_path))
+    assert step == 50
+
+
+def test_checkpoint_resave_same_step(rng, tmp_path):
+    save_checkpoint(str(tmp_path), 7, _tree(rng))
+    tree2 = _tree(rng, 3.0)
+    save_checkpoint(str(tmp_path), 7, tree2)
+    _, out, _ = load_latest_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(out["w"], tree2["w"])
+
+
+def test_empty_dir_has_no_checkpoints(tmp_path):
+    assert load_latest_checkpoint(str(tmp_path)) is None
+    assert latest_checkpoint(str(tmp_path)) is None
+
+
+# ------------------------------------------------------------- metrics bus
+
+
+def test_aggregate_metrics_current_step_only():
+    recs = [
+        LocalMetrics(step=3, samples_per_second=10.0, samples_accumulated=64,
+                     loss=8.0, mini_steps=4),
+        LocalMetrics(step=3, samples_per_second=5.0, samples_accumulated=32,
+                     loss=4.0, mini_steps=2),
+        LocalMetrics(step=2, samples_per_second=7.0, samples_accumulated=99,
+                     loss=100.0, mini_steps=1),  # stale peer
+    ]
+    agg = aggregate_metrics(recs)
+    assert agg["step"] == 3
+    assert agg["alive_peers"] == 3  # stale peer still alive
+    assert agg["samples_accumulated"] == 96  # current step only
+    assert agg["samples_per_second"] == 22.0  # all peers
+    assert agg["loss"] == (8.0 + 4.0) / (4 + 2)
+
+
+def test_aggregate_metrics_empty():
+    assert aggregate_metrics([]) is None
+
+
+def test_metrics_validator_chain_has_signature_subkey():
+    validators, public_key = make_validators("exp")
+    assert public_key.startswith(b"rsa:")
+    assert len(validators) == 2

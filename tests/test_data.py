@@ -1,0 +1,152 @@
+"""Tests for MLM/SOP instance building, masking, streaming, and disk shards."""
+import numpy as np
+import pytest
+
+from dedloc_tpu.data.disk import tokenized_dataset_batches, write_shards
+from dedloc_tpu.data.mlm import (
+    SpecialTokens,
+    create_instances_from_document,
+    mask_tokens,
+    pad_and_batch,
+)
+from dedloc_tpu.data.streaming import (
+    ShuffleBuffer,
+    batched,
+    interleave_weighted,
+    peer_shuffle_seed,
+    repeat_forever,
+)
+
+TOK = SpecialTokens(vocab_size=1000)
+
+
+def _sentences(rng, n, lo=5, hi=20):
+    return [
+        rng.integers(TOK.num_reserved, TOK.vocab_size, rng.integers(lo, hi)).tolist()
+        for _ in range(n)
+    ]
+
+
+def test_instances_structure(rng):
+    sents = _sentences(rng, 50)
+    instances = create_instances_from_document(sents, 64, rng, TOK)
+    assert instances
+    for inst in instances:
+        ids = inst["input_ids"]
+        assert len(ids) <= 64
+        assert ids[0] == TOK.cls_id
+        assert ids[-1] == TOK.sep_id
+        # exactly one or two SEPs + CLS marked special
+        special_positions = np.flatnonzero(inst["special_tokens_mask"])
+        assert set(ids[special_positions]) <= {TOK.cls_id, TOK.sep_id}
+        # token types: 0s then 1s
+        tt = inst["token_type_ids"]
+        assert np.all(np.diff(tt) >= 0)
+
+
+def test_instances_sop_labels_balanced(rng):
+    sents = _sentences(rng, 2000)
+    instances = create_instances_from_document(sents, 64, rng, TOK)
+    labels = [int(i["sop_label"]) for i in instances]
+    frac = np.mean(labels)
+    assert 0.3 < frac < 0.7  # ~50% swapped
+
+
+def test_mask_tokens_statistics(rng):
+    batch = pad_and_batch(
+        create_instances_from_document(_sentences(rng, 400), 64, rng, TOK), 64, TOK
+    )
+    masked = mask_tokens(batch, rng, TOK, mlm_probability=0.15)
+    labelled = masked["mlm_labels"] != -100
+    maskable = (batch["special_tokens_mask"] == 0) & (batch["attention_mask"] == 1)
+    rate = labelled.sum() / maskable.sum()
+    assert 0.10 < rate < 0.20
+    # special tokens never labelled
+    assert not np.any(labelled & ~maskable)
+    # ~80% of labelled become [MASK]
+    mask_rate = (masked["input_ids"][labelled] == TOK.mask_id).mean()
+    assert 0.7 < mask_rate < 0.9
+    # labels hold ORIGINAL ids
+    np.testing.assert_array_equal(
+        masked["mlm_labels"][labelled], batch["input_ids"][labelled]
+    )
+
+
+def test_interleave_weighted_ratio():
+    a, b = ["a"] * 10000, ["b"] * 10000
+    out = []
+    for x in interleave_weighted([a, b], [0.23, 0.77], seed=0):
+        out.append(x)
+        if len(out) >= 5000:
+            break
+    frac_b = out.count("b") / len(out)
+    assert 0.7 < frac_b < 0.85
+
+
+def test_interleave_redistributes_on_exhaustion():
+    out = list(interleave_weighted([[1, 2], ["x"] * 20], [0.5, 0.5], seed=0))
+    assert sorted(str(o) for o in out) == sorted(["1", "2"] + ["x"] * 20)
+
+
+def test_shuffle_buffer_permutes_and_preserves():
+    items = list(range(500))
+    out = list(ShuffleBuffer(buffer_size=100, seed=1)(iter(items)))
+    assert sorted(out) == items
+    assert out != items
+
+
+def test_peer_shuffle_seed_deterministic_and_distinct():
+    s1 = peer_shuffle_seed(b"rsa:peer-one")
+    assert s1 == peer_shuffle_seed(b"rsa:peer-one")
+    assert s1 != peer_shuffle_seed(b"rsa:peer-two")
+    assert 0 <= s1 < 2**31
+
+
+def test_repeat_forever_restarts():
+    calls = []
+
+    def factory():
+        calls.append(1)
+        return [1, 2, 3]
+
+    it = repeat_forever(factory)
+    out = [next(it) for _ in range(7)]
+    assert out == [1, 2, 3, 1, 2, 3, 1]
+    assert len(calls) >= 2
+
+
+def test_repeat_forever_raises_on_empty_source():
+    it = repeat_forever(lambda: [])
+    with pytest.raises(RuntimeError):
+        next(it)
+
+
+def test_batched_drops_partial():
+    assert list(batched(range(7), 3)) == [[0, 1, 2], [3, 4, 5]]
+
+
+def test_disk_shards_roundtrip(rng, tmp_path):
+    class Cfg:
+        vocab_size = TOK.vocab_size
+        max_position_embeddings = 64
+
+    batches = [
+        pad_and_batch(
+            create_instances_from_document(_sentences(rng, 100), 64, rng, TOK),
+            64,
+            TOK,
+        )
+        for _ in range(3)
+    ]
+    total = write_shards(str(tmp_path), iter(batches), examples_per_shard=16)
+    assert total == sum(len(b["input_ids"]) for b in batches)
+
+    stream = tokenized_dataset_batches(str(tmp_path), Cfg, 4, 64, seed=0)
+    batch = next(stream)
+    assert batch["input_ids"].shape == (4, 64)
+    assert "mlm_labels" in batch
+    assert batch["attention_mask"].dtype == np.int32
+    # stream is infinite: pull more batches than one epoch holds
+    n_epoch = total // 4
+    for _ in range(n_epoch + 2):
+        next(stream)
