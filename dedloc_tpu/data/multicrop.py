@@ -1,0 +1,71 @@
+"""Multicrop pipeline: crop-group batching + synthetic image fixture.
+
+Capability parity with the reference's SwAV data path: ``ImgPilToMultiCrop``
+generates 2 global 224² + 6 local 96² views per image
+(swav/vissl/vissl/data/ssl_transforms/img_pil_to_multicrop.py:11-74), the
+multicrop collator groups same-resolution crops so the trunk runs once per
+resolution (data/collators/multicrop_collator.py:7-55 +
+base_ssl_model.py:76), and SyntheticImageDataset provides the test fixture
+(data/synthetic_dataset.py:7-53).
+
+Real image decoding/augmentation stays outside the framework (a data-side
+wheel concern, SURVEY.md §2.7); this module defines the crop-group batch
+STRUCTURE the jitted SwAV step consumes: a list of [N, H_i, W_i, C] arrays,
+one per resolution group, in crop order.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiCropSpec:
+    """2×224 + 6×96 by default (swav_1node_resnet_submit.yaml:32-37)."""
+
+    sizes: Sequence[int] = (224, 96)
+    counts: Sequence[int] = (2, 6)
+    channels: int = 3
+
+    @property
+    def num_crops(self) -> int:
+        return sum(self.counts)
+
+    @staticmethod
+    def tiny(**overrides) -> "MultiCropSpec":
+        base = dict(sizes=(32, 16), counts=(2, 2))
+        base.update(overrides)
+        return MultiCropSpec(**base)
+
+
+def crop_groups(spec: MultiCropSpec, batch_size: int) -> List[Tuple[int, int]]:
+    """(count, size) per resolution group — the static shape contract between
+    the data pipeline and the jitted SwAV step."""
+    return [(c * batch_size, s) for s, c in zip(spec.sizes, spec.counts)]
+
+
+def synthetic_multicrop_batches(
+    spec: MultiCropSpec,
+    batch_size: int,
+    seed: int = 0,
+    num_classes: int = 8,
+) -> Iterator[List[np.ndarray]]:
+    """Synthetic multicrop stream (SyntheticImageDataset capability): each
+    "image" is a class-dependent mean plus noise; crops of one image share
+    its mean, so crops agree like real augmented views do. Yields one
+    [count*B, S, S, C] float32 array per resolution group, in crop order."""
+    rng = np.random.default_rng(seed)
+    while True:
+        means = rng.standard_normal((batch_size, 1, 1, spec.channels)) * 0.5
+        groups: List[np.ndarray] = []
+        for size, count in zip(spec.sizes, spec.counts):
+            views = []
+            for _ in range(count):
+                noise = rng.standard_normal(
+                    (batch_size, size, size, spec.channels)
+                ).astype(np.float32) * 0.1
+                views.append((means + noise).astype(np.float32))
+            groups.append(np.concatenate(views, axis=0))
+        yield groups

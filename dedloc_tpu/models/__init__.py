@@ -3,3 +3,15 @@ from dedloc_tpu.models.albert import (
     AlbertForPreTraining,
     albert_pretraining_loss,
 )
+from dedloc_tpu.models.resnet import ResNet, ResNetConfig
+from dedloc_tpu.models.swav import (
+    SwAVConfig,
+    SwAVModel,
+    SwAVPrototypesHead,
+    SwAVQueue,
+    SwAVTrainState,
+    make_swav_train_step,
+    normalize_prototypes,
+    sinkhorn_knopp,
+    swav_loss,
+)

@@ -1,0 +1,151 @@
+"""ResNet-50 trunk in Flax, TPU-first (NHWC, bf16 compute, fp32 BN stats).
+
+Capability parity with the vissl trunk the SwAV workload trains
+(reference: swav/vissl/vissl/models/trunks/resnext.py:49-172; resnet is an
+alias, trunks/resnet.py:4-6). Not a port: layout is NHWC (TPU conv layout),
+compute dtype bf16 with fp32 batch-norm statistics.
+
+SyncBN (apex capability, swav_1node_resnet_submit.yaml:73-76) needs no knob
+under jit/pjit: BN statistics are means over the GLOBAL batch axis, so when
+the batch is sharded over a mesh XLA lowers them to cross-device psums
+automatically. ``bn_axis_name`` exists ONLY for shard_map/pmap execution,
+where the per-device batch is local and the reduction axis must be named;
+leave it None under jit/pjit (a bound name does not exist there and would
+fail at trace time).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    """ResNet-50 defaults (the reference's only trunk config)."""
+
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+    # ONLY for shard_map/pmap (named-axis) execution; None under jit/pjit,
+    # where global-batch BN is automatic (see module docstring)
+    bn_axis_name: Optional[str] = None
+
+    @staticmethod
+    def resnet50(**overrides) -> "ResNetConfig":
+        return ResNetConfig(**overrides)
+
+    @staticmethod
+    def tiny(**overrides) -> "ResNetConfig":
+        """Test-sized trunk (SURVEY.md §4 fixture pattern)."""
+        base = dict(stage_sizes=(1, 1, 1, 1), width=8)
+        base.update(overrides)
+        return ResNetConfig(**base)
+
+    @property
+    def out_features(self) -> int:
+        return self.width * 8 * 4  # final stage channels × bottleneck expansion
+
+
+class _ConvBN(nn.Module):
+    cfg: ResNetConfig
+    features: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    use_relu: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(
+            self.features,
+            self.kernel,
+            self.strides,
+            padding=[(k // 2, k // 2) for k in self.kernel],
+            use_bias=False,
+            dtype=self.cfg.dtype,
+            param_dtype=jnp.float32,
+            name="conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=self.cfg.bn_momentum,
+            epsilon=self.cfg.bn_eps,
+            dtype=jnp.float32,
+            axis_name=self.cfg.bn_axis_name if train else None,
+            name="bn",
+        )(x)
+        return nn.relu(x) if self.use_relu else x
+
+
+class _Bottleneck(nn.Module):
+    """1x1 reduce -> 3x3 -> 1x1 expand (x4), residual add."""
+
+    cfg: ResNetConfig
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        residual = x
+        y = _ConvBN(self.cfg, self.features, (1, 1), name="reduce")(x, train)
+        y = _ConvBN(self.cfg, self.features, (3, 3), self.strides, name="conv3x3")(
+            y, train
+        )
+        y = _ConvBN(
+            self.cfg, self.features * 4, (1, 1), use_relu=False, name="expand"
+        )(y, train)
+        if residual.shape != y.shape:
+            residual = _ConvBN(
+                self.cfg,
+                self.features * 4,
+                (1, 1),
+                self.strides,
+                use_relu=False,
+                name="proj",
+            )(x, train)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Returns globally-pooled [N, out_features] trunk features."""
+
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        cfg = self.cfg
+        x = images.astype(cfg.dtype)
+        x = nn.Conv(
+            cfg.width,
+            (7, 7),
+            (2, 2),
+            padding=[(3, 3), (3, 3)],
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            name="stem_conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=cfg.bn_momentum,
+            epsilon=cfg.bn_eps,
+            dtype=jnp.float32,
+            axis_name=cfg.bn_axis_name if train else None,
+            name="stem_bn",
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
+        for stage, n_blocks in enumerate(cfg.stage_sizes):
+            for block in range(n_blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = _Bottleneck(
+                    cfg,
+                    cfg.width * (2**stage),
+                    strides,
+                    name=f"stage{stage}_block{block}",
+                )(x, train)
+        return jnp.mean(x, axis=(1, 2)).astype(jnp.float32)  # global avg pool

@@ -1,0 +1,354 @@
+"""SwAV: prototypes head, distributed sinkhorn assignment, swapped-prediction
+loss, embedding queue, and the prototype hooks.
+
+Capability parity with the reference's SwAV stack:
+- head: MLP 2048→2048→128 + L2 normalize + bias-free prototype layers
+  (swav/vissl/vissl/models/heads/swav_prototypes_head.py:10-112)
+- loss: swapped prediction over multicrop views with sinkhorn-knopp
+  assignments, 3 iterations, epsilon 0.05, temperature 0.1, optional queue
+  and hard assignment (swav/vissl/vissl/losses/swav_loss.py:117-381)
+- hooks: queue-score refresh on forward + prototype L2 normalization on
+  update (swav/vissl/vissl/hooks/swav_hooks.py:11-93), prototype freezing
+  for the first iterations (state_update_hooks.py:235-280)
+
+NOT a port — the distributed design is inverted for TPU: the reference calls
+``all_reduce_sum`` inside the sinkhorn loop over NCCL (swav_loss.py:194-236);
+here sinkhorn is plain jnp on the GLOBAL (sharded) batch inside jit, so under
+pjit the row/column sums lower to ICI psums automatically and the whole loop
+fuses into the step program. Queue state is an explicit pytree carried
+through the step function (functional, donate-able) instead of module
+buffers mutated in place.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from dedloc_tpu.models.resnet import ResNet, ResNetConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class SwAVConfig:
+    """swav_1node_resnet_submit.yaml defaults (:33-37,68,93-104)."""
+
+    trunk: ResNetConfig = ResNetConfig.resnet50()
+    proj_dims: Sequence[int] = (2048, 2048, 128)
+    num_prototypes: Sequence[int] = (3000,)
+    temperature: float = 0.1
+    epsilon: float = 0.05
+    sinkhorn_iters: int = 3
+    num_crops: int = 8  # 2×224 + 6×96
+    crops_for_assign: Sequence[int] = (0, 1)
+    queue_length: int = 0  # per-peer feature queue (0 = disabled)
+    queue_start_step: int = 0
+    freeze_prototypes_steps: int = 313  # TEMP_FROZEN_PARAMS_ITER_MAP capability
+    use_bn_in_head: bool = True
+
+    @staticmethod
+    def tiny(**overrides) -> "SwAVConfig":
+        base = dict(
+            trunk=ResNetConfig.tiny(),
+            proj_dims=(256, 64, 16),
+            num_prototypes=(32,),
+            num_crops=4,
+            freeze_prototypes_steps=0,
+        )
+        base.update(overrides)
+        return SwAVConfig(**base)
+
+
+class SwAVPrototypesHead(nn.Module):
+    """Projection MLP (BN+ReLU between layers, skipped after the last) →
+    L2 normalize → one bias-free Linear per prototype head."""
+
+    cfg: SwAVConfig
+
+    @nn.compact
+    def __call__(self, features, train: bool = True):
+        cfg = self.cfg
+        x = features.astype(jnp.float32)
+        dims = list(cfg.proj_dims)
+        for i, dim in enumerate(dims[1:]):
+            x = nn.Dense(dim, param_dtype=jnp.float32, name=f"proj{i}")(x)
+            if i == len(dims) - 2:
+                break  # skip_last_bn
+            if cfg.use_bn_in_head:
+                x = nn.BatchNorm(
+                    use_running_average=not train,
+                    momentum=0.9,
+                    epsilon=1e-5,
+                    dtype=jnp.float32,
+                    name=f"proj_bn{i}",
+                )(x)
+            x = nn.relu(x)
+        # L2 normalize the embeddings before clustering
+        x = x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+        scores = [
+            nn.Dense(k, use_bias=False, param_dtype=jnp.float32, name=f"prototypes{i}")(
+                x
+            )
+            for i, k in enumerate(cfg.num_prototypes)
+        ]
+        return x, scores
+
+
+class SwAVModel(nn.Module):
+    """Trunk + head over a multicrop batch.
+
+    ``crops`` is a list of [N, H_i, W_i, C] arrays (one per crop resolution
+    group, mirroring multi_res_input_forward at base_ssl_model.py:76 which
+    batches same-resolution crops through the trunk together). Returns
+    (embeddings [N*num_crops, D], scores list of [N*num_crops, K]).
+    """
+
+    cfg: SwAVConfig
+
+    @nn.compact
+    def __call__(self, crops: Sequence[jnp.ndarray], train: bool = True):
+        trunk = ResNet(self.cfg.trunk, name="trunk")
+        feats = jnp.concatenate([trunk(c, train) for c in crops], axis=0)
+        return SwAVPrototypesHead(self.cfg, name="head")(feats, train)
+
+
+# ----------------------------------------------------------------- sinkhorn
+
+
+def sinkhorn_knopp(
+    scores: jnp.ndarray,
+    num_iters: int = 3,
+    epsilon: float = 0.05,
+    hard: bool = False,
+) -> jnp.ndarray:
+    """Sinkhorn-knopp assignment (swav_loss.py:177-244 semantics).
+
+    ``scores``: [N, K] prototype scores for the assignment crop (the GLOBAL
+    batch — under pjit the sums below reduce across devices over ICI; no
+    manual collectives, unlike the reference's all_reduce_sum-in-loop).
+    Returns [N, K] assignment probabilities (rows sum to 1).
+    """
+    scores = scores.astype(jnp.float32)
+    n, k = scores.shape
+    # log-sum-exp stabilization (swav_loss.py:266-271): subtract the global max
+    q = jnp.exp(scores / epsilon - jnp.max(scores / epsilon))
+    q = q.T  # [K, N] — following the paper's Q convention
+    q = q / jnp.maximum(q.sum(), 1e-12)
+
+    def body(_, q):
+        # rows (prototypes) to uniform 1/K
+        u = jnp.maximum(q.sum(axis=1, keepdims=True), 1e-12)
+        q = q / (k * u)
+        # columns (samples) to uniform 1/N
+        v = jnp.maximum(q.sum(axis=0, keepdims=True), 1e-12)
+        q = q / (n * v)
+        return q
+
+    q = jax.lax.fori_loop(0, num_iters, body, q)
+    q = q / jnp.maximum(q.sum(axis=0, keepdims=True), 1e-12)  # final col norm
+    assignments = q.T  # [N, K], rows sum to 1
+    if hard:
+        idx = jnp.argmax(assignments, axis=1)
+        assignments = jax.nn.one_hot(idx, k, dtype=jnp.float32)
+    return jax.lax.stop_gradient(assignments)
+
+
+# --------------------------------------------------------------------- loss
+
+
+def swav_loss(
+    scores: Sequence[jnp.ndarray],
+    cfg: SwAVConfig,
+    queue_scores: Optional[jnp.ndarray] = None,
+    use_queue: bool = False,
+    hard_assignment: bool = False,
+) -> jnp.ndarray:
+    """Swapped-prediction loss over all prototype heads
+    (swav_loss.py:246-326 semantics).
+
+    ``scores[h]``: [num_crops*B, K_h], crops stacked along axis 0 in crop
+    order. ``queue_scores``: [num_heads, len(crops_for_assign), Q, K] scores
+    of queued embeddings (refreshed against CURRENT prototypes by the caller
+    — the SwAVUpdateQueueScoresHook capability). Queued samples only sharpen
+    the assignment statistics; losses are computed on the live batch.
+    """
+    total = 0.0
+    for h, s in enumerate(scores):
+        bs = s.shape[0] // cfg.num_crops
+        head_loss = 0.0
+        for i, crop_id in enumerate(cfg.crops_for_assign):
+            crop_scores = jax.lax.dynamic_slice_in_dim(s, bs * crop_id, bs, 0)
+            if use_queue and queue_scores is not None:
+                assign_in = jnp.concatenate(
+                    [crop_scores, queue_scores[h, i]], axis=0
+                )
+            else:
+                assign_in = crop_scores
+            assignments = sinkhorn_knopp(
+                assign_in, cfg.sinkhorn_iters, cfg.epsilon, hard=hard_assignment
+            )[:bs]
+            pred_crops = [p for p in range(cfg.num_crops) if p != crop_id]
+            crop_loss = 0.0
+            for p in pred_crops:
+                logp = jax.nn.log_softmax(
+                    jax.lax.dynamic_slice_in_dim(s, bs * p, bs, 0)
+                    / cfg.temperature,
+                    axis=1,
+                )
+                crop_loss -= jnp.mean(jnp.sum(assignments * logp, axis=1))
+            head_loss += crop_loss / len(pred_crops)
+        total += head_loss / len(cfg.crops_for_assign)
+    return total / len(scores)
+
+
+# -------------------------------------------------------------------- queue
+
+
+class SwAVQueue(struct.PyTreeNode):
+    """Embedding queue per assignment crop (swav_loss.py:328-366), as an
+    explicit functional pytree: newest embeddings at the front."""
+
+    embeddings: jnp.ndarray  # [len(crops_for_assign), Q, D]
+
+    @classmethod
+    def create(cls, cfg: SwAVConfig, rng: jax.Array) -> "SwAVQueue":
+        d = cfg.proj_dims[-1]
+        stdv = 1.0 / jnp.sqrt(jnp.asarray(d / 3.0))
+        emb = jax.random.uniform(
+            rng,
+            (len(cfg.crops_for_assign), cfg.queue_length, d),
+            jnp.float32,
+            -stdv,
+            stdv,
+        )
+        return cls(embeddings=emb)
+
+    def update(self, embeddings: jnp.ndarray, cfg: SwAVConfig) -> "SwAVQueue":
+        """Shift-in this step's assignment-crop embeddings
+        (update_emb_queue semantics: queue[bs:] = queue[:-bs];
+        queue[:bs] = new)."""
+        bs = embeddings.shape[0] // cfg.num_crops
+        new_queues = []
+        for i, crop_id in enumerate(cfg.crops_for_assign):
+            fresh = jax.lax.dynamic_slice_in_dim(
+                embeddings, bs * crop_id, bs, 0
+            )
+            shifted = jnp.concatenate(
+                [fresh, self.embeddings[i, : -bs or None]], axis=0
+            )
+            new_queues.append(shifted[: self.embeddings.shape[1]])
+        return self.replace(embeddings=jnp.stack(new_queues))
+
+    def scores(self, head_params, cfg: SwAVConfig) -> jnp.ndarray:
+        """Refresh queue scores against CURRENT prototypes
+        (SwAVUpdateQueueScoresHook.on_forward, swav_hooks.py:26-38).
+        Returns [num_heads, len(crops_for_assign), Q, K]."""
+        per_head = []
+        for h in range(len(cfg.num_prototypes)):
+            w = head_params[f"prototypes{h}"]["kernel"]  # [D, K]
+            per_head.append(jnp.einsum("cqd,dk->cqk", self.embeddings, w))
+        return jnp.stack(per_head)
+
+
+# -------------------------------------------------------------------- hooks
+
+
+def _is_prototype_path(path) -> bool:
+    return any(
+        str(getattr(p, "key", "")).startswith("prototypes") for p in path
+    )
+
+
+def normalize_prototypes(params):
+    """L2-normalize prototype rows after each update
+    (NormalizePrototypesHook.on_update, swav_hooks.py:55-92)."""
+
+    def maybe_normalize(path, leaf):
+        if _is_prototype_path(path) and str(getattr(path[-1], "key", "")) == "kernel":
+            # [D, K]: each prototype is a column
+            norm = jnp.maximum(jnp.linalg.norm(leaf, axis=0, keepdims=True), 1e-12)
+            return leaf / norm
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(maybe_normalize, params)
+
+
+def freeze_prototypes_grads(grads, step, freeze_steps: int):
+    """Zero prototype gradients for the first ``freeze_steps`` global steps
+    (FreezeParametersHook capability, state_update_hooks.py:235-280), as a
+    jit-safe mask on the gradient pytree."""
+    frozen = step < freeze_steps
+
+    def maybe_freeze(path, leaf):
+        if _is_prototype_path(path):
+            return jnp.where(frozen, jnp.zeros_like(leaf), leaf)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(maybe_freeze, grads)
+
+
+# --------------------------------------------------------------- train step
+
+
+class SwAVTrainState(struct.PyTreeNode):
+    """Step counter keyed by the GLOBAL collaboration step (fed to the loss
+    for queue gating, the fork seam at standard_train_step.py:153)."""
+
+    step: jnp.ndarray
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    queue: Optional[SwAVQueue] = None
+
+
+def make_swav_train_step(model: SwAVModel, cfg: SwAVConfig, tx):
+    """Fused jitted step: forward (BN stats mutable), swav loss (+queue),
+    prototype freeze mask, optimizer update, prototype re-normalization,
+    queue shift-in. ``use_queue`` is static (two compiled variants, like the
+    reference's queue.start_iter gate at swav_loss.py:84-91)."""
+
+    def train_step(state: SwAVTrainState, crops, use_queue: bool):
+        queue_scores = (
+            state.queue.scores(state.params["head"], cfg)
+            if (use_queue and state.queue is not None)
+            else None
+        )
+
+        def loss_fn(params):
+            (emb, scores), mutated = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                crops,
+                True,
+                mutable=["batch_stats"],
+            )
+            loss = swav_loss(scores, cfg, queue_scores, use_queue=use_queue)
+            return loss, (mutated["batch_stats"], emb)
+
+        (loss, (new_bn, emb)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        grads = freeze_prototypes_grads(
+            grads, state.step, cfg.freeze_prototypes_steps
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_params = normalize_prototypes(new_params)
+        new_queue = (
+            state.queue.update(emb, cfg) if state.queue is not None else None
+        )
+        return (
+            state.replace(
+                step=state.step + 1,
+                params=new_params,
+                batch_stats=new_bn,
+                opt_state=new_opt,
+                queue=new_queue,
+            ),
+            {"loss": loss},
+        )
+
+    return jax.jit(train_step, static_argnums=(2,), donate_argnums=(0,))
