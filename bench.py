@@ -32,18 +32,21 @@ def main() -> None:
     from dedloc_tpu.models.albert import (
         AlbertConfig,
         AlbertForPreTraining,
-        albert_pretraining_loss,
+        albert_pretraining_loss_gathered,
     )
     from dedloc_tpu.optim import lamb
     from dedloc_tpu.parallel.train_step import TrainState, make_local_train_step
 
     tiny = os.environ.get("DEDLOC_BENCH_TINY", "") == "1"
     if tiny:  # CI smoke on CPU
-        cfg = AlbertConfig.tiny()
+        cfg = AlbertConfig.tiny(remat_policy="dots_no_batch")
         accum, per_step, seq, iters = 2, 4, 64, 3
     else:
-        cfg = AlbertConfig.large()
+        cfg = AlbertConfig.large(remat_policy="dots_no_batch")
         accum, per_step, seq, iters = 2, 32, 512, 5
+    # gathered masked-position MLM head: vocab projection only where labels
+    # exist (~15% of positions) — the TPU-native layout
+    max_pred = int(seq * 0.15) + 4
 
     model = AlbertForPreTraining(cfg)
     rng = jax.random.PRNGKey(0)
@@ -53,26 +56,38 @@ def main() -> None:
 
     def loss_fn(params, batch, rng):
         mlm_logits, sop_logits = model.apply(
-            {"params": params}, batch["input_ids"], batch["attention_mask"]
+            {"params": params},
+            batch["input_ids"],
+            batch["attention_mask"],
+            mlm_positions=batch["mlm_positions"],
         )
-        return albert_pretraining_loss(
-            mlm_logits, sop_logits, batch["mlm_labels"], batch["sop_labels"]
+        return albert_pretraining_loss_gathered(
+            mlm_logits,
+            sop_logits,
+            batch["mlm_label_ids"],
+            batch["mlm_weights"],
+            batch["sop_labels"],
         )
 
     host = np.random.default_rng(0)
+    ids = host.integers(5, cfg.vocab_size, (accum, per_step, seq)).astype(np.int32)
+    labelled = host.random((accum, per_step, seq)) < 0.15
+    labelled &= np.cumsum(labelled, axis=2) <= max_pred
+    positions = np.zeros((accum, per_step, max_pred), np.int32)
+    label_ids = np.zeros((accum, per_step, max_pred), np.int32)
+    weights = np.zeros((accum, per_step, max_pred), np.float32)
+    for a in range(accum):
+        for i in range(per_step):
+            idx = np.flatnonzero(labelled[a, i])
+            positions[a, i, : len(idx)] = idx
+            label_ids[a, i, : len(idx)] = ids[a, i, idx]
+            weights[a, i, : len(idx)] = 1.0
     batch = {
-        "input_ids": jnp.asarray(
-            host.integers(0, cfg.vocab_size, (accum, per_step, seq)), jnp.int32
-        ),
+        "input_ids": jnp.asarray(ids),
         "attention_mask": jnp.ones((accum, per_step, seq), jnp.int32),
-        "mlm_labels": jnp.asarray(
-            np.where(
-                host.random((accum, per_step, seq)) < 0.15,
-                host.integers(0, cfg.vocab_size, (accum, per_step, seq)),
-                -100,
-            ),
-            jnp.int32,
-        ),
+        "mlm_positions": jnp.asarray(positions),
+        "mlm_label_ids": jnp.asarray(label_ids),
+        "mlm_weights": jnp.asarray(weights),
         "sop_labels": jnp.asarray(host.integers(0, 2, (accum, per_step)), jnp.int32),
     }
 

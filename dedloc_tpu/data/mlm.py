@@ -131,14 +131,28 @@ def mask_tokens(
     tokens: SpecialTokens,
     mlm_probability: float = 0.15,
     ignore_index: int = -100,
+    max_predictions: int = 0,
 ) -> Dict[str, np.ndarray]:
     """Whole-batch vectorized MLM masking (DataCollatorForLanguageModeling
     semantics): 15% of maskable positions become labels; 80% of those are
-    replaced by [MASK], 10% by a random non-special token, 10% kept."""
+    replaced by [MASK], 10% by a random non-special token, 10% kept.
+
+    With ``max_predictions > 0`` the batch additionally carries the gathered
+    TPU-native label layout — ``mlm_positions``/``mlm_label_ids``/
+    ``mlm_weights`` [B, max_predictions] — so the model can run the vocab
+    projection on prediction positions only. Labelled positions beyond
+    ``max_predictions`` are demoted back to unlabelled (and unmasked), so
+    the two layouts stay consistent.
+    """
     input_ids = batch["input_ids"]
     maskable = (batch["special_tokens_mask"] == 0) & (batch["attention_mask"] == 1)
     probs = rng.random(input_ids.shape)
     labelled = (probs < mlm_probability) & maskable
+
+    if max_predictions:
+        # keep at most max_predictions labels per row (drop the excess)
+        cum = np.cumsum(labelled, axis=1)
+        labelled &= cum <= max_predictions
 
     mlm_labels = np.where(labelled, input_ids, ignore_index).astype(np.int32)
 
@@ -155,4 +169,18 @@ def mask_tokens(
     out = dict(batch)
     out["input_ids"] = new_ids
     out["mlm_labels"] = mlm_labels
+    if max_predictions:
+        b, s = input_ids.shape
+        positions = np.zeros((b, max_predictions), np.int32)
+        label_ids = np.zeros((b, max_predictions), np.int32)
+        weights = np.zeros((b, max_predictions), np.float32)
+        for i in range(b):
+            idx = np.flatnonzero(labelled[i])
+            n = len(idx)
+            positions[i, :n] = idx
+            label_ids[i, :n] = input_ids[i, idx]
+            weights[i, :n] = 1.0
+        out["mlm_positions"] = positions
+        out["mlm_label_ids"] = label_ids
+        out["mlm_weights"] = weights
     return out

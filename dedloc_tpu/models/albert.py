@@ -45,6 +45,14 @@ class AlbertConfig:
     pad_token_id: int = 0
     dtype: Any = jnp.bfloat16  # compute dtype; params stay fp32
     remat: bool = True
+    # rematerialization policy for the scanned layer: "nothing" saves no
+    # activations (min HBM), "dots" saves matmul outputs (fewer recomputed
+    # MXU ops when HBM allows)
+    remat_policy: str = "nothing"
+    # "dense" (materialized S² scores) or "blockwise" (online-softmax over KV
+    # blocks, O(S·block) memory — the long-context path; exact, not approx)
+    attention_impl: str = "dense"
+    attention_block_size: int = 512
 
     @staticmethod
     def large(**overrides) -> "AlbertConfig":
@@ -94,18 +102,29 @@ class AlbertSelfAttention(nn.Module):
         k = split_heads(_dense(cfg.hidden_size, cfg, "key")(hidden))
         v = split_heads(_dense(cfg.hidden_size, cfg, "value")(hidden))
 
-        # fp32 logits + softmax for numerical stability; bf16 everywhere else.
-        scale = 1.0 / jnp.sqrt(head_dim).astype(jnp.float32)
-        logits = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-        ) * scale
-        logits = logits + attn_bias  # additive mask: 0 keep / -inf drop
-        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        if cfg.attention_dropout_prob > 0.0 and not deterministic:
-            probs = nn.Dropout(cfg.attention_dropout_prob)(
-                probs, deterministic=deterministic
-            )
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H)
+        if cfg.attention_impl == "blockwise":
+            # long-context path: exact online-softmax over KV blocks — never
+            # materializes the S×S score matrix (attention dropout is 0.0 in
+            # the reference recipe, so the fused path loses nothing)
+            from dedloc_tpu.parallel.ring_attention import blockwise_attention
+
+            kv_bias = attn_bias[:, 0, 0, :]  # additive [B, S_kv]
+            ctx = blockwise_attention(
+                q, k, v, kv_bias, block_size=cfg.attention_block_size
+            ).reshape(B, S, H)
+        else:
+            # fp32 logits + softmax for numerical stability; bf16 elsewhere.
+            scale = 1.0 / jnp.sqrt(head_dim).astype(jnp.float32)
+            logits = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+            ) * scale
+            logits = logits + attn_bias  # additive mask: 0 keep / -inf drop
+            probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+            if cfg.attention_dropout_prob > 0.0 and not deterministic:
+                probs = nn.Dropout(cfg.attention_dropout_prob)(
+                    probs, deterministic=deterministic
+                )
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H)
         out = _dense(cfg.hidden_size, cfg, "dense")(ctx)
         if cfg.hidden_dropout_prob > 0.0 and not deterministic:
             out = nn.Dropout(cfg.hidden_dropout_prob)(out, deterministic=deterministic)
@@ -145,9 +164,14 @@ class _ScannedAlbertLayer(nn.Module):
     def __call__(self, hidden, attn_bias):
         layer_cls = AlbertLayer
         if self.cfg.remat:
-            layer_cls = nn.remat(
-                AlbertLayer, policy=jax.checkpoint_policies.nothing_saveable
-            )
+            policy = {
+                "nothing": jax.checkpoint_policies.nothing_saveable,
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+                "dots_no_batch": (
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                ),
+            }[self.cfg.remat_policy]
+            layer_cls = nn.remat(AlbertLayer, policy=policy)
         out = layer_cls(self.cfg, self.deterministic, name="block")(hidden, attn_bias)
         return out, ()
 
@@ -253,12 +277,23 @@ class AlbertForPreTraining(nn.Module):
         attention_mask=None,
         token_type_ids=None,
         deterministic: bool = True,
+        mlm_positions=None,
     ):
+        """``mlm_positions`` [B, P]: when given, the MLM head runs only on
+        those gathered positions (returns [B, P, vocab]) — the TPU-native
+        masked-position path that skips ~85% of the vocab-projection FLOPs.
+        When None, logits cover every position (reference-equivalent)."""
         cfg = self.cfg
         backbone = AlbertModel(cfg, name="albert")
         hidden, pooled = backbone(
             input_ids, attention_mask, token_type_ids, deterministic
         )
+
+        if mlm_positions is not None:
+            # gather [B, P, H] prediction positions before the vocab matmul
+            hidden = jnp.take_along_axis(
+                hidden, mlm_positions[..., None].astype(jnp.int32), axis=1
+            )
 
         # MLM head: hidden -> embedding_size -> vocab (tied decoder).
         x = _dense(cfg.embedding_size, cfg, "mlm_dense")(hidden)
@@ -312,6 +347,39 @@ def albert_pretraining_loss(
         "sop_loss": sop_loss,
         "mlm_acc": (
             (jnp.argmax(mlm_logits, axis=-1) == safe_labels).astype(jnp.float32) * mask
+        ).sum()
+        / denom,
+    }
+    return loss, metrics
+
+
+def albert_pretraining_loss_gathered(
+    mlm_logits: jnp.ndarray,  # [B, P, vocab] — logits at gathered positions
+    sop_logits: jnp.ndarray,
+    mlm_label_ids: jnp.ndarray,  # [B, P]
+    mlm_weights: jnp.ndarray,  # [B, P] 1.0 real prediction / 0.0 padding
+    sop_labels: jnp.ndarray,
+) -> Tuple[jnp.ndarray, dict]:
+    """Masked-position variant of the MLM+SOP loss (same value as the dense
+    loss for equal label sets; see the gathered-head path above)."""
+    w = mlm_weights.astype(jnp.float32)
+    logp = jax.nn.log_softmax(mlm_logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, mlm_label_ids[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(w.sum(), 1.0)
+    mlm_loss = (nll * w).sum() / denom
+
+    sop_logp = jax.nn.log_softmax(sop_logits.astype(jnp.float32), axis=-1)
+    sop_nll = -jnp.take_along_axis(sop_logp, sop_labels[:, None], axis=-1)[:, 0]
+    sop_loss = sop_nll.mean()
+
+    loss = mlm_loss + sop_loss
+    metrics = {
+        "loss": loss,
+        "mlm_loss": mlm_loss,
+        "sop_loss": sop_loss,
+        "mlm_acc": (
+            (jnp.argmax(mlm_logits, axis=-1) == mlm_label_ids).astype(jnp.float32)
+            * w
         ).sum()
         / denom,
     }

@@ -16,6 +16,7 @@ from dedloc_tpu.models.albert import (
     AlbertConfig,
     AlbertForPreTraining,
     albert_pretraining_loss,
+    albert_pretraining_loss_gathered,
 )
 from dedloc_tpu.optim import lamb, linear_warmup_linear_decay
 from dedloc_tpu.utils.logging import get_logger
@@ -66,13 +67,26 @@ def build_dht(args: CollaborationArguments, client_mode: Optional[bool] = None):
 
 
 def build_loss_fn(model: AlbertForPreTraining) -> Callable:
+    """Gathered masked-position loss when the batch carries ``mlm_positions``
+    (the fast TPU layout); dense per-position loss otherwise."""
+
     def loss_fn(params, batch, rng):
+        gathered = "mlm_positions" in batch
         mlm_logits, sop_logits = model.apply(
             {"params": params},
             batch["input_ids"],
             batch["attention_mask"],
             batch["token_type_ids"],
+            mlm_positions=batch["mlm_positions"] if gathered else None,
         )
+        if gathered:
+            return albert_pretraining_loss_gathered(
+                mlm_logits,
+                sop_logits,
+                batch["mlm_label_ids"],
+                batch["mlm_weights"],
+                batch["sop_labels"],
+            )
         return albert_pretraining_loss(
             mlm_logits, sop_logits, batch["mlm_labels"], batch["sop_labels"]
         )
@@ -91,6 +105,7 @@ def synthetic_mlm_batches(
     rng = np.random.default_rng(seed)
     tokens = SpecialTokens(vocab_size=cfg.vocab_size)
     seq_length = min(seq_length, cfg.max_position_embeddings)
+    max_predictions = int(seq_length * 0.15) + 4
     while True:
         ids = rng.integers(
             tokens.num_reserved, cfg.vocab_size, (batch_size, seq_length)
@@ -102,16 +117,27 @@ def synthetic_mlm_batches(
             "special_tokens_mask": np.zeros((batch_size, seq_length), np.int32),
             "sop_labels": rng.integers(0, 2, (batch_size,)).astype(np.int32),
         }
-        yield mask_tokens(batch, rng, tokens)
+        yield mask_tokens(batch, rng, tokens, max_predictions=max_predictions)
 
 
 def drop_collator_keys(batch: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
     """Keep only what the jitted loss consumes (static arg structure)."""
-    keep = (
-        "input_ids",
-        "attention_mask",
-        "token_type_ids",
-        "mlm_labels",
-        "sop_labels",
-    )
+    if "mlm_positions" in batch:
+        keep = (
+            "input_ids",
+            "attention_mask",
+            "token_type_ids",
+            "mlm_positions",
+            "mlm_label_ids",
+            "mlm_weights",
+            "sop_labels",
+        )
+    else:
+        keep = (
+            "input_ids",
+            "attention_mask",
+            "token_type_ids",
+            "mlm_labels",
+            "sop_labels",
+        )
     return {k: jnp.asarray(batch[k]) for k in keep}

@@ -95,3 +95,30 @@ def test_masked_loss_ignores_unlabelled(tiny_model):
     loss, metrics = albert_pretraining_loss(mlm, sopl, all_ignored, sop)
     assert float(metrics["mlm_loss"]) == 0.0
     assert np.isfinite(float(loss))
+
+
+def test_blockwise_attention_impl_matches_dense():
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dedloc_tpu.models.albert import AlbertConfig, AlbertForPreTraining
+
+    rng = np.random.default_rng(0)
+    dense_cfg = AlbertConfig.tiny(dtype=jnp.float32)
+    block_cfg = dataclasses.replace(
+        dense_cfg, attention_impl="blockwise", attention_block_size=16
+    )
+    ids = jnp.asarray(rng.integers(0, dense_cfg.vocab_size, (2, 32)), jnp.int32)
+    mask = jnp.asarray(rng.random((2, 32)) > 0.2, jnp.int32)
+    params = AlbertForPreTraining(dense_cfg).init(jax.random.PRNGKey(0), ids, mask)[
+        "params"
+    ]
+    mlm_d, sop_d = AlbertForPreTraining(dense_cfg).apply(
+        {"params": params}, ids, mask
+    )
+    mlm_b, sop_b = AlbertForPreTraining(block_cfg).apply(
+        {"params": params}, ids, mask
+    )
+    np.testing.assert_allclose(np.asarray(mlm_d), np.asarray(mlm_b), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(sop_d), np.asarray(sop_b), atol=2e-4)
