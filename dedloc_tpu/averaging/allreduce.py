@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from dedloc_tpu import native
 from dedloc_tpu.core.serialization import (
     CompressionType,
     deserialize_array,
@@ -113,7 +114,10 @@ class GroupAllReduce:
         data, weight = await asyncio.wait_for(
             asyncio.shield(state.reduced), timeout=self.timeout
         )
-        return {"data": serialize_array(data, self.compression), "weight": weight}
+        return {
+            "data": serialize_array(data, self.compression, checksum=True),
+            "weight": weight,
+        }
 
     # ------------------------------------------------------------------ run
 
@@ -156,9 +160,12 @@ class GroupAllReduce:
                 ),
                 timeout=self.timeout,
             )
-        except (asyncio.TimeoutError, ConnectionError, OSError, RPCError) as e:
+        except (
+            asyncio.TimeoutError, ConnectionError, OSError, RPCError, ValueError,
+        ) as e:
             # RPCError covers remote-side failures (a host whose handler timed
-            # out or crashed replies ok=False) — a failed round must cost one
+            # out or crashed replies ok=False); ValueError covers corrupt
+            # frames (checksum/shape mismatch) — a failed round must cost one
             # round, not the training process
             raise AllreduceFailed(f"round {round_id}: {e!r}") from e
         finally:
@@ -191,7 +198,7 @@ class GroupAllReduce:
                 "sender": my_index,
                 "weight": weight if weight > 0 else 0.0,
                 "data": (
-                    serialize_array(vector[lo:hi], self.compression)
+                    serialize_array(vector[lo:hi], self.compression, checksum=True)
                     if weight > 0
                     else None
                 ),
@@ -222,8 +229,8 @@ class GroupAllReduce:
                 acc = np.zeros(hi - lo, np.float32)
                 for part, w in my_state.parts.values():
                     if part is not None and w > 0:
-                        acc += part * w
-                reduced = acc / total_w
+                        native.axpy(acc, part, w)  # acc += w * part, in C++
+                reduced = native.scale(acc, 1.0 / total_w)
             else:  # all-aux group: nothing to average
                 reduced = vector[lo:hi].astype(np.float32)
             if not my_state.reduced.done():

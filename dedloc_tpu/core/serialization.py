@@ -11,10 +11,12 @@ the caller at the jit↔asyncio seam (SURVEY.md §7 hard-part b).
 from __future__ import annotations
 
 import enum
-from typing import Any, Dict, Tuple
+from typing import Any, Dict
 
 import msgpack
 import numpy as np
+
+from dedloc_tpu import native
 
 
 class CompressionType(enum.Enum):
@@ -23,16 +25,10 @@ class CompressionType(enum.Enum):
     UINT8 = "uint8"  # per-tensor affine quantization with fp32 scale/zero-point
 
 
-def _quantize_uint8(x: np.ndarray) -> Tuple[np.ndarray, float, float]:
-    lo = float(x.min()) if x.size else 0.0
-    hi = float(x.max()) if x.size else 0.0
-    scale = (hi - lo) / 255.0 or 1.0
-    q = np.clip(np.round((x - lo) / scale), 0, 255).astype(np.uint8)
-    return q, lo, scale
-
-
 def serialize_array(
-    x: np.ndarray, compression: CompressionType = CompressionType.NONE
+    x: np.ndarray,
+    compression: CompressionType = CompressionType.NONE,
+    checksum: bool = False,
 ) -> bytes:
     x = np.asarray(x)
     header: Dict[str, Any] = {
@@ -43,32 +39,40 @@ def serialize_array(
     if compression is CompressionType.NONE:
         payload = np.ascontiguousarray(x).tobytes()
     elif compression is CompressionType.FLOAT16:
-        payload = np.ascontiguousarray(x.astype(np.float16)).tobytes()
+        if x.dtype == np.float16:
+            payload = np.ascontiguousarray(x).tobytes()
+        else:
+            payload = native.f32_to_f16(x.astype(np.float32, copy=False)).tobytes()
     elif compression is CompressionType.UINT8:
-        q, lo, scale = _quantize_uint8(x.astype(np.float32))
+        q, lo, scale = native.quantize_uint8(x.astype(np.float32, copy=False))
         header["lo"], header["scale"] = lo, scale
         payload = q.tobytes()
     else:  # pragma: no cover
         raise ValueError(f"unknown compression {compression}")
+    if checksum:
+        header["crc"] = native.crc32c(payload)
     return msgpack.packb({"h": header, "p": payload}, use_bin_type=True)
 
 
 def deserialize_array(data: bytes) -> np.ndarray:
     obj = msgpack.unpackb(data, raw=False)
     header, payload = obj["h"], obj["p"]
+    if "crc" in header and native.crc32c(payload) != header["crc"]:
+        raise ValueError("wire chunk checksum mismatch (corrupt frame)")
     shape = tuple(header["shape"])
     dtype = np.dtype(header["dtype"])
     compression = CompressionType(header["compression"])
     if compression is CompressionType.NONE:
         return np.frombuffer(payload, dtype=dtype).reshape(shape).copy()
     if compression is CompressionType.FLOAT16:
-        return (
-            np.frombuffer(payload, dtype=np.float16).reshape(shape).astype(dtype)
-        )
+        h = np.frombuffer(payload, dtype=np.float16).reshape(shape)
+        if dtype == np.float16:
+            return h.copy()
+        return native.f16_to_f32(h).astype(dtype, copy=False)
     if compression is CompressionType.UINT8:
         q = np.frombuffer(payload, dtype=np.uint8).reshape(shape)
-        x = q.astype(np.float32) * header["scale"] + header["lo"]
-        return x.astype(dtype)
+        x = native.dequantize_uint8(q, header["lo"], header["scale"])
+        return x.astype(dtype, copy=False)
     raise ValueError(f"unknown compression {compression}")  # pragma: no cover
 
 

@@ -21,4 +21,8 @@ def get_logger(name: str = "dedloc_tpu") -> logging.Logger:
         root.setLevel(level)
         root.propagate = False
         _configured = True
+    if not name.startswith("dedloc_tpu"):
+        # role CLIs run as ``python -m`` get __name__ == "__main__"; fold
+        # them under the package root so they share its handler/level
+        name = f"dedloc_tpu.{name}"
     return logging.getLogger(name)
