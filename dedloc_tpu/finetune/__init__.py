@@ -1,0 +1,20 @@
+"""Downstream fine-tuning of collaboratively pretrained checkpoints.
+
+Capability parity with the reference's evaluation scripts
+(sahajbert/train_ner.py — wikiann/bn token classification with seqeval
+P/R/F1 + early stopping; sahajbert/train_ncc.py — indic_glue sna.bn
+sequence classification with accuracy), rebuilt as jitted JAX loops with
+static shapes (pad-to-max, the TPU-friendly layout the reference's
+``pad_to_max_length`` flag notes is required on TPU).
+"""
+from dedloc_tpu.finetune.driver import (  # noqa: F401
+    EarlyStopping,
+    FinetuneArguments,
+    evaluate,
+    finetune,
+)
+from dedloc_tpu.finetune.metrics import (  # noqa: F401
+    accuracy_score,
+    extract_entities,
+    span_f1,
+)

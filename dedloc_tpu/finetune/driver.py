@@ -1,0 +1,264 @@
+"""Generic jitted fine-tune loop with early stopping.
+
+The TPU-native stand-in for the reference's HF ``Trainer`` +
+``EarlyStoppingCallback`` fine-tune skeleton (train_ner.py:107-125:
+load_best_model_at_end, metric_for_best_model="loss", per-epoch eval,
+patience 1 / threshold 0.0 defaults): one jitted AdamW train step over
+static-shape batches, per-epoch evaluation, best-params restore.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dedloc_tpu.models.albert import classification_loss
+from dedloc_tpu.optim.schedules import linear_warmup_linear_decay
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class FinetuneArguments:
+    """Knobs mirroring the fine-tune TrainingArguments the reference sets."""
+
+    learning_rate: float = 5e-5
+    weight_decay: float = 0.0
+    num_train_epochs: int = 3
+    per_device_batch_size: int = 32
+    warmup_ratio: float = 0.1
+    seed: int = 0
+    # EarlyStoppingCallback knobs (train_ner.py:97-104 defaults)
+    early_stopping_patience: int = 1
+    early_stopping_threshold: float = 0.0
+    metric_for_best_model: str = "loss"
+    greater_is_better: bool = False
+    classifier_dropout: float = 0.1
+
+
+class EarlyStopping:
+    """load_best_model_at_end + EarlyStoppingCallback in one object."""
+
+    def __init__(
+        self,
+        patience: int = 1,
+        threshold: float = 0.0,
+        greater_is_better: bool = False,
+    ):
+        self.patience = patience
+        self.threshold = threshold
+        self.greater_is_better = greater_is_better
+        self.best: Optional[float] = None
+        self.bad_evals = 0
+
+    def improved(self, value: float) -> bool:
+        if self.best is None:
+            return True
+        if self.greater_is_better:
+            return value > self.best + self.threshold
+        return value < self.best - self.threshold
+
+    def record(self, value: float) -> bool:
+        """Returns True when training should STOP."""
+        if self.improved(value):
+            self.best = value
+            self.bad_evals = 0
+            return False
+        self.bad_evals += 1
+        return self.bad_evals >= self.patience
+
+
+def _batches(data: Dict[str, np.ndarray], batch_size: int, rng: np.random.Generator):
+    """Shuffled fixed-shape batches; the final ragged batch is wrapped around
+    (static shapes keep one compiled program — the TPU constraint the
+    reference's pad_to_max_length note points at)."""
+    n = len(next(iter(data.values())))
+    order = rng.permutation(n)
+    if n % batch_size:
+        # np.resize tiles the permutation, so this holds even when the pad
+        # needed exceeds n (e.g. n=10, batch_size=32)
+        order = np.resize(order, n + batch_size - n % batch_size)
+    for i in range(0, len(order), batch_size):
+        idx = order[i : i + batch_size]
+        yield {k: v[idx] for k, v in data.items()}
+
+
+@functools.lru_cache(maxsize=8)
+def _make_eval_step(apply_fn: Callable):
+    """Jitted eval step, cached per apply_fn so repeated evaluate() calls
+    (one per epoch) reuse the compiled program."""
+
+    @jax.jit
+    def eval_step(params, batch):
+        logits = apply_fn(
+            params,
+            batch["input_ids"],
+            batch["attention_mask"],
+            batch.get("token_type_ids"),
+        )
+        loss, metrics = classification_loss(logits, batch["labels"])
+        return jnp.argmax(logits, axis=-1), loss * metrics["n_labels"], metrics[
+            "n_labels"
+        ]
+
+    return eval_step
+
+
+def evaluate(
+    apply_fn: Callable,
+    params,
+    data: Dict[str, np.ndarray],
+    batch_size: int,
+) -> Tuple[float, np.ndarray]:
+    """Returns (mean masked loss, predictions over the full set, unshuffled)."""
+    eval_step = _make_eval_step(apply_fn)
+    n = len(data["input_ids"])
+    preds = []
+    total_loss = 0.0
+    total_labels = 0.0
+    for i in range(0, n, batch_size):
+        idx = np.arange(i, min(i + batch_size, n))
+        real = len(idx)
+        if real < batch_size:  # pad to static shape, then slice off
+            idx = np.concatenate([idx, np.zeros(batch_size - real, np.int64)])
+        batch = {k: v[idx].copy() for k, v in data.items()}
+        batch["labels"][real:] = -100  # padding rows contribute no loss
+        p, loss_sum, n_lab = eval_step(params, batch)
+        preds.append(np.asarray(p)[:real])
+        total_loss += float(loss_sum)
+        total_labels += float(n_lab)
+    return total_loss / max(1.0, total_labels), np.concatenate(preds, axis=0)
+
+
+def finetune(
+    model,
+    init_params,
+    train_data: Dict[str, np.ndarray],
+    eval_data: Dict[str, np.ndarray],
+    args: FinetuneArguments,
+    compute_metrics: Optional[Callable[[np.ndarray], Dict[str, float]]] = None,
+):
+    """Fine-tune ``model`` (a flax Module with the classification call
+    signature) and return (best_params, history).
+
+    ``init_params`` may carry a pretrained ``albert`` subtree (the
+    collaborative checkpoint); missing heads are freshly initialised.
+    ``compute_metrics(predictions)`` turns eval predictions into a metric
+    dict (the reference's compute_metrics seam, train_ncc.py:199-205).
+    """
+    rng = np.random.default_rng(args.seed)
+    n = len(train_data["input_ids"])
+    steps_per_epoch = max(1, (n + args.per_device_batch_size - 1) // (
+        args.per_device_batch_size
+    ))
+    total_steps = steps_per_epoch * args.num_train_epochs
+    schedule = linear_warmup_linear_decay(
+        args.learning_rate, int(args.warmup_ratio * total_steps), total_steps
+    )
+    tx = optax.adamw(schedule, weight_decay=args.weight_decay)
+
+    init_rng = jax.random.PRNGKey(args.seed)
+    sample = {
+        k: jnp.asarray(v[: args.per_device_batch_size]) for k, v in train_data.items()
+    }
+    params = model.init(
+        {"params": init_rng, "dropout": init_rng},
+        sample["input_ids"],
+        sample["attention_mask"],
+        sample.get("token_type_ids"),
+        deterministic=True,
+    )["params"]
+    if init_params is not None and "albert" in init_params:
+        # warm-start the backbone from the pretrained checkpoint
+        params = dict(params)
+        params["albert"] = init_params["albert"]
+    opt_state = tx.init(params)
+
+    def apply_train(params, ids, mask, types, dropout_rng):
+        return model.apply(
+            {"params": params},
+            ids,
+            mask,
+            types,
+            deterministic=False,
+            rngs={"dropout": dropout_rng},
+        )
+
+    def apply_eval(params, ids, mask, types):
+        return model.apply({"params": params}, ids, mask, types, deterministic=True)
+
+    @jax.jit
+    def train_step(params, opt_state, batch, dropout_rng):
+        dropout_rng, step_rng = jax.random.split(dropout_rng)
+
+        def loss_fn(p):
+            logits = apply_train(
+                p,
+                batch["input_ids"],
+                batch["attention_mask"],
+                batch.get("token_type_ids"),
+                step_rng,
+            )
+            loss, metrics = classification_loss(logits, batch["labels"])
+            return loss, metrics
+
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics, dropout_rng
+
+    stopper = EarlyStopping(
+        args.early_stopping_patience,
+        args.early_stopping_threshold,
+        args.greater_is_better,
+    )
+    best_params = params
+    dropout_rng = jax.random.PRNGKey(args.seed + 1)
+    history = []
+    for epoch in range(args.num_train_epochs):
+        train_loss = 0.0
+        steps = 0
+        for batch in _batches(train_data, args.per_device_batch_size, rng):
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            params, opt_state, metrics, dropout_rng = train_step(
+                params, opt_state, batch, dropout_rng
+            )
+            train_loss += float(metrics["loss"])
+            steps += 1
+        eval_loss, preds = evaluate(
+            apply_eval, params, eval_data, args.per_device_batch_size
+        )
+        record = {
+            "epoch": epoch,
+            "train_loss": train_loss / max(1, steps),
+            "eval_loss": eval_loss,
+        }
+        if compute_metrics is not None:
+            record.update(compute_metrics(preds))
+        history.append(record)
+        logger.info("finetune epoch %d: %s", epoch, record)
+
+        key = f"eval_{args.metric_for_best_model}"
+        if key in record:
+            value = record[key]
+        elif args.metric_for_best_model in record:
+            value = record[args.metric_for_best_model]
+        else:
+            # silently substituting eval_loss would invert the optimization
+            # direction when greater_is_better=True — fail loudly instead
+            raise ValueError(
+                f"metric_for_best_model={args.metric_for_best_model!r} not found "
+                f"in eval record; available: {sorted(record)}"
+            )
+        if stopper.improved(value):
+            best_params = params
+        if stopper.record(value):
+            logger.info("early stopping at epoch %d (best=%s)", epoch, stopper.best)
+            break
+    return best_params, history

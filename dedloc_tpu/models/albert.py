@@ -316,6 +316,105 @@ class AlbertForPreTraining(nn.Module):
         return mlm_logits, sop_logits
 
 
+class AlbertForTokenClassification(nn.Module):
+    """ALBERT with a per-token classifier head.
+
+    Capability of ``AutoModelForTokenClassification`` as used by the
+    reference's NER fine-tune driver (sahajbert/train_ner.py:160-168):
+    backbone hidden states -> dropout -> Dense(num_labels) in fp32.
+    """
+
+    cfg: AlbertConfig
+    num_labels: int
+    classifier_dropout: float = 0.1
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        attention_mask=None,
+        token_type_ids=None,
+        deterministic: bool = True,
+    ):
+        hidden, _ = AlbertModel(self.cfg, name="albert")(
+            input_ids, attention_mask, token_type_ids, deterministic
+        )
+        if self.classifier_dropout > 0.0 and not deterministic:
+            hidden = nn.Dropout(self.classifier_dropout)(
+                hidden, deterministic=deterministic
+            )
+        return _dense(self.num_labels, self.cfg, "classifier")(hidden).astype(
+            jnp.float32
+        )
+
+
+class AlbertForSequenceClassification(nn.Module):
+    """ALBERT with a pooled-output classifier head.
+
+    Capability of ``AutoModelForSequenceClassification`` as used by the
+    reference's news-category fine-tune driver (sahajbert/train_ncc.py:25,159):
+    pooled [CLS] -> dropout -> Dense(num_labels) in fp32.
+    """
+
+    cfg: AlbertConfig
+    num_labels: int
+    classifier_dropout: float = 0.1
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        attention_mask=None,
+        token_type_ids=None,
+        deterministic: bool = True,
+    ):
+        _, pooled = AlbertModel(self.cfg, name="albert")(
+            input_ids, attention_mask, token_type_ids, deterministic
+        )
+        if self.classifier_dropout > 0.0 and not deterministic:
+            pooled = nn.Dropout(self.classifier_dropout)(
+                pooled, deterministic=deterministic
+            )
+        return _dense(self.num_labels, self.cfg, "classifier")(pooled).astype(
+            jnp.float32
+        )
+
+
+def _masked_cross_entropy(
+    logits: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Masked-mean CE + accuracy over positions where ``mask`` is 1.
+
+    ``labels`` must already be clamped into [0, num_classes). Returns
+    (loss, accuracy, denom) with denom = max(mask.sum(), 1).
+    """
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / denom
+    acc = ((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32) * mask).sum() / (
+        denom
+    )
+    return loss, acc, denom
+
+
+def classification_loss(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    ignore_index: int = -100,
+) -> Tuple[jnp.ndarray, dict]:
+    """Cross-entropy over any leading shape, masked-mean over labels != -100.
+
+    Serves both fine-tune heads: token classification ([B, S, L] logits with
+    -100 on special/continuation tokens, train_ner.py:199-209) and sequence
+    classification ([B, L] logits, all labelled).
+    """
+    mask = (labels != ignore_index).astype(jnp.float32)
+    safe = jnp.where(labels == ignore_index, 0, labels)
+    loss, acc, _ = _masked_cross_entropy(logits, safe, mask)
+    return loss, {"loss": loss, "accuracy": acc, "n_labels": mask.sum()}
+
+
 def albert_pretraining_loss(
     mlm_logits: jnp.ndarray,
     sop_logits: jnp.ndarray,
@@ -328,13 +427,9 @@ def albert_pretraining_loss(
     Matches the loss AlbertForPreTraining computes (MLM CE over positions with
     label != -100 plus SOP CE over the pooled output).
     """
-    vocab = mlm_logits.shape[-1]
     mask = (mlm_labels != ignore_index).astype(jnp.float32)
     safe_labels = jnp.where(mlm_labels == ignore_index, 0, mlm_labels)
-    logp = jax.nn.log_softmax(mlm_logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
-    denom = jnp.maximum(mask.sum(), 1.0)
-    mlm_loss = (nll * mask).sum() / denom
+    mlm_loss, mlm_acc, _ = _masked_cross_entropy(mlm_logits, safe_labels, mask)
 
     sop_logp = jax.nn.log_softmax(sop_logits.astype(jnp.float32), axis=-1)
     sop_nll = -jnp.take_along_axis(sop_logp, sop_labels[:, None], axis=-1)[:, 0]
@@ -345,10 +440,7 @@ def albert_pretraining_loss(
         "loss": loss,
         "mlm_loss": mlm_loss,
         "sop_loss": sop_loss,
-        "mlm_acc": (
-            (jnp.argmax(mlm_logits, axis=-1) == safe_labels).astype(jnp.float32) * mask
-        ).sum()
-        / denom,
+        "mlm_acc": mlm_acc,
     }
     return loss, metrics
 
@@ -363,10 +455,7 @@ def albert_pretraining_loss_gathered(
     """Masked-position variant of the MLM+SOP loss (same value as the dense
     loss for equal label sets; see the gathered-head path above)."""
     w = mlm_weights.astype(jnp.float32)
-    logp = jax.nn.log_softmax(mlm_logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, mlm_label_ids[..., None], axis=-1)[..., 0]
-    denom = jnp.maximum(w.sum(), 1.0)
-    mlm_loss = (nll * w).sum() / denom
+    mlm_loss, mlm_acc, _ = _masked_cross_entropy(mlm_logits, mlm_label_ids, w)
 
     sop_logp = jax.nn.log_softmax(sop_logits.astype(jnp.float32), axis=-1)
     sop_nll = -jnp.take_along_axis(sop_logp, sop_labels[:, None], axis=-1)[:, 0]
@@ -377,10 +466,6 @@ def albert_pretraining_loss_gathered(
         "loss": loss,
         "mlm_loss": mlm_loss,
         "sop_loss": sop_loss,
-        "mlm_acc": (
-            (jnp.argmax(mlm_logits, axis=-1) == mlm_label_ids).astype(jnp.float32)
-            * w
-        ).sum()
-        / denom,
+        "mlm_acc": mlm_acc,
     }
     return loss, metrics

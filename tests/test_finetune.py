@@ -1,0 +1,180 @@
+"""Downstream fine-tuning: span-F1 metrics, label alignment, and tiny
+end-to-end NER/NCC runs (the reference's train_ner.py / train_ncc.py
+capabilities on synthetic Bengali-shaped data)."""
+import numpy as np
+import pytest
+
+from dedloc_tpu.finetune.driver import EarlyStopping, FinetuneArguments
+from dedloc_tpu.finetune.metrics import (
+    accuracy_score,
+    align_labels_with_words,
+    extract_entities,
+    span_f1,
+)
+from dedloc_tpu.finetune.ner import WIKIANN_LABELS, encode_ner_examples, run_ner
+from dedloc_tpu.finetune.ncc import encode_ncc_examples, run_ncc
+from dedloc_tpu.models.albert import AlbertConfig
+
+
+def test_extract_entities_bio():
+    tags = ["O", "B-PER", "I-PER", "O", "B-LOC", "B-ORG", "I-ORG"]
+    assert extract_entities(tags) == {
+        ("PER", 1, 3),
+        ("LOC", 4, 5),
+        ("ORG", 5, 7),
+    }
+
+
+def test_extract_entities_orphan_continuation():
+    # bare I-X opens a span (seqeval lenient default); type switch closes it
+    assert extract_entities(["I-PER", "I-LOC"]) == {("PER", 0, 1), ("LOC", 1, 2)}
+    assert extract_entities(["B-PER", "I-PER", "I-PER"]) == {("PER", 0, 3)}
+
+
+def test_span_f1_perfect_and_partial():
+    ref = [["B-PER", "I-PER", "O"]]
+    assert span_f1(ref, ref)["f1"] == 1.0
+    m = span_f1([["B-PER", "O", "O"]], ref)
+    assert m["precision"] == 0.0 and m["recall"] == 0.0
+    assert m["accuracy"] == pytest.approx(2 / 3)
+
+
+def test_align_labels_with_words():
+    # word_ids for "[CLS] to k1 k2 [SEP]" where word 1 has two sub-tokens
+    word_ids = [None, 0, 1, 1, None]
+    labels = align_labels_with_words(word_ids, [3, 5])
+    assert labels == [-100, 3, 5, -100, -100]
+    labels_all = align_labels_with_words(word_ids, [3, 5], label_all_tokens=True)
+    assert labels_all == [-100, 3, 5, 5, -100]
+
+
+def test_accuracy_score():
+    assert accuracy_score([1, 2, 3], [1, 2, 0]) == pytest.approx(2 / 3)
+
+
+def test_early_stopping_patience():
+    s = EarlyStopping(patience=2, threshold=0.0, greater_is_better=False)
+    assert not s.record(1.0)
+    assert not s.record(0.9)
+    assert not s.record(0.95)  # worse: bad_evals=1
+    assert s.record(0.92)  # worse again: stop
+    assert s.best == 0.9
+
+
+def _fake_word_tokenizer(words):
+    """Deterministic sub-word splitter: word i -> 1 + (len(word) > 3) tokens."""
+    ids, word_ids = [2], [None]  # [CLS]
+    for wi, w in enumerate(words):
+        n = 2 if len(w) > 3 else 1
+        for _ in range(n):
+            ids.append(5 + (hash(w) % 100))
+            word_ids.append(wi)
+    ids.append(3)  # [SEP]
+    word_ids.append(None)
+    return {"input_ids": ids, "word_ids": word_ids}
+
+
+def _ner_examples(n, rng):
+    examples = []
+    for _ in range(n):
+        length = rng.integers(3, 7)
+        words = [f"w{rng.integers(0, 30)}" + "x" * rng.integers(0, 4) for _ in range(length)]
+        tags = []
+        i = 0
+        while i < length:
+            if rng.random() < 0.3:
+                tags.append(1)  # B-PER
+                if i + 1 < length and rng.random() < 0.5:
+                    tags.append(2)  # I-PER
+                    i += 2
+                    continue
+            else:
+                tags.append(0)
+            i += 1
+        examples.append({"tokens": words, "ner_tags": tags[:length]})
+    return examples
+
+
+def test_encode_ner_examples_shapes(rng):
+    examples = _ner_examples(4, rng)
+    data = encode_ner_examples(examples, _fake_word_tokenizer, max_seq_length=32)
+    assert data["input_ids"].shape == (4, 32)
+    assert data["labels"].shape == (4, 32)
+    # CLS position is always ignored; padding is ignored
+    assert (data["labels"][:, 0] == -100).all()
+    assert ((data["labels"] != -100) <= (data["attention_mask"] > 0)).all()
+
+
+def test_run_ner_end_to_end(rng):
+    from dedloc_tpu.finetune.ner import NerArguments
+
+    args = NerArguments(
+        max_seq_length=32,
+        train=FinetuneArguments(
+            num_train_epochs=2,
+            per_device_batch_size=4,
+            learning_rate=1e-3,
+            early_stopping_patience=3,
+        ),
+    )
+    cfg = AlbertConfig.tiny(vocab_size=128, max_position_embeddings=32)
+    params, history = run_ner(
+        args,
+        cfg,
+        _ner_examples(12, rng),
+        _ner_examples(6, rng),
+        _fake_word_tokenizer,
+    )
+    assert len(history) >= 1
+    assert np.isfinite(history[-1]["eval_loss"])
+    assert "eval_f1" in history[-1]
+
+
+def test_run_ncc_end_to_end(rng):
+    from dedloc_tpu.finetune.ncc import NccArguments
+
+    def tokenize_text(text):
+        return [2] + [5 + (ord(c) % 50) for c in text[:20]] + [3]
+
+    examples = [
+        {"text": f"news story {i} " + "ab" * (i % 5), "label": i % 3}
+        for i in range(16)
+    ]
+    args = NccArguments(
+        max_seq_length=24,
+        train=FinetuneArguments(
+            num_train_epochs=2, per_device_batch_size=4, learning_rate=1e-3
+        ),
+    )
+    cfg = AlbertConfig.tiny(vocab_size=128, max_position_embeddings=24)
+    params, history = run_ncc(
+        args, cfg, examples[:12], examples[12:], tokenize_text,
+        label_list=["a", "b", "c"],
+    )
+    assert len(history) >= 1
+    assert 0.0 <= history[-1]["eval_accuracy"] <= 1.0
+
+
+def test_finetune_warm_start_uses_pretrained_backbone(rng):
+    """init_params['albert'] must be carried into the fine-tuned params."""
+    import jax
+    import jax.numpy as jnp
+
+    from dedloc_tpu.finetune.driver import finetune
+    from dedloc_tpu.models.albert import AlbertForSequenceClassification
+
+    cfg = AlbertConfig.tiny(vocab_size=64, max_position_embeddings=16)
+    model = AlbertForSequenceClassification(cfg, num_labels=2)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    pre = model.init(jax.random.PRNGKey(7), ids)["params"]
+    marker = jax.tree_util.tree_map(lambda x: x * 0 + 0.123, pre["albert"])
+
+    data = {
+        "input_ids": np.ones((4, 16), np.int32),
+        "attention_mask": np.ones((4, 16), np.int32),
+        "labels": np.array([0, 1, 0, 1], np.int32),
+    }
+    args = FinetuneArguments(num_train_epochs=0, per_device_batch_size=4)
+    best, _ = finetune(model, {"albert": marker}, data, data, args)
+    leaf = jax.tree_util.tree_leaves(best["albert"])[0]
+    assert np.allclose(np.asarray(leaf), 0.123)

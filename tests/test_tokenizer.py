@@ -1,0 +1,97 @@
+"""Tokenizer pipeline: Unigram training in-memory, Bengali normalization
+repairs, template post-processing, word_ids for NER alignment, save/load."""
+import pytest
+
+from dedloc_tpu.data.tokenizer import (
+    CLS_ID,
+    SEP_ID,
+    FastTokenizer,
+    build_unigram_tokenizer,
+    train_unigram_tokenizer,
+)
+
+CORPUS = [
+    "আমি বাংলায় গান গাই",
+    "তুমি কেমন আছো বন্ধু",
+    "এই শহরে অনেক মানুষ থাকে",
+    "the quick brown fox jumps over the lazy dog",
+    "hello world 1234",
+] * 20
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return FastTokenizer(train_unigram_tokenizer(CORPUS, vocab_size=200))
+
+
+def test_special_token_ids(tok):
+    vocab = tok.tokenizer.get_vocab()
+    assert vocab["<pad>"] == 0
+    assert vocab["<unk>"] == 1
+    assert vocab["[CLS]"] == 2
+    assert vocab["[SEP]"] == 3
+    assert vocab["[MASK]"] == 4
+
+
+def test_encode_adds_template(tok):
+    ids = tok.encode_ids("আমি গান গাই")
+    assert ids[0] == CLS_ID and ids[-1] == SEP_ID
+
+
+def test_encode_pair_type_ids(tok):
+    enc = tok.encode_pair("আমি গান", "তুমি কেমন")
+    ids, types = enc["input_ids"], enc["token_type_ids"]
+    assert ids[0] == CLS_ID
+    assert ids.count(SEP_ID) == 2
+    second_sep = len(ids) - 1
+    first_sep = ids.index(SEP_ID)
+    assert all(t == 0 for t in types[: first_sep + 1])
+    assert all(t == 1 for t in types[first_sep + 1 : second_sep + 1])
+
+
+def test_bengali_normalization_repairs():
+    # ASCII pipe and deprecated danda -> U+0964; colon after Bengali -> viserga
+    tok = build_unigram_tokenizer()
+    assert tok.normalizer.normalize_str("ক|") == "ক।"
+    assert tok.normalizer.normalize_str("ক৤") == "ক।"
+    assert tok.normalizer.normalize_str("দুঃ") == "দুঃ"
+    assert tok.normalizer.normalize_str("ক:") == "কঃ"
+    assert tok.normalizer.normalize_str("a:") == "a:"
+    assert tok.normalizer.normalize_str("HeLLo") == "hello"
+    assert tok.normalizer.normalize_str("a  b") == "a b"
+
+
+def test_digits_split_individually(tok):
+    ids = tok.encode_ids("1234")
+    # template adds CLS/SEP; 4 digits must not merge into one token
+    assert len(ids) >= 6
+
+
+def test_word_ids_for_ner(tok):
+    out = tok.tokenize_words(["আমি", "বাংলায়", "গাই"])
+    assert out["word_ids"][0] is None  # [CLS]
+    assert out["word_ids"][-1] is None  # [SEP]
+    seen = [w for w in out["word_ids"] if w is not None]
+    assert sorted(set(seen)) == [0, 1, 2]
+    assert len(out["input_ids"]) == len(out["word_ids"])
+
+
+def test_save_load_roundtrip(tok, tmp_path):
+    p = str(tmp_path / "tokenizer.json")
+    tok.save(p)
+    tok2 = FastTokenizer.load(p)
+    text = "আমি বাংলায় গান গাই"
+    assert tok2.encode_ids(text) == tok.encode_ids(text)
+
+
+def test_transformers_adapter(tok):
+    hf = tok.to_transformers()
+    out = hf("আমি গান গাই")
+    assert out["input_ids"][0] == CLS_ID
+    assert hf.pad_token_id == 0 and hf.mask_token_id == 4
+
+
+def test_decode_roundtrip(tok):
+    text = "hello world"
+    ids = tok.encode_ids(text)
+    assert "hello" in tok.decode(ids).replace(" ", "")  or "hello" in tok.decode(ids)
