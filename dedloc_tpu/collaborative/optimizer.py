@@ -98,6 +98,7 @@ class CollaborativeOptimizer:
         verbose: bool = False,
         listen_host: str = "0.0.0.0",
         advertised_host: Optional[str] = None,
+        post_apply: Optional[Callable[[TrainState], TrainState]] = None,
     ):
         assert not (client_mode and auxiliary), "an auxiliary peer must listen"
         self.tx = tx
@@ -141,6 +142,10 @@ class CollaborativeOptimizer:
         self.local_step = 0
         self.local_samples_accumulated = 0
         self._apply_fn = make_apply_step(tx, mesh=mesh)
+        # post-update transform on the new state (e.g. SwAV prototype
+        # re-normalization — NormalizePrototypesHook.on_update capability,
+        # swav_hooks.py:55-92); runs once per GLOBAL step inside jit
+        self.post_apply = post_apply
         self._lock = threading.Lock()
         self._last_good: Optional[Tuple[Any, int]] = None  # host (params, opt)
         self._desynced = False
@@ -250,6 +255,8 @@ class CollaborativeOptimizer:
                         "local grads, will resync"
                     )
             new_state = self._apply_fn(state, mean_grads)
+            if self.post_apply is not None:
+                new_state = self.post_apply(new_state)
             if not bool(params_are_finite(new_state.params)):
                 # NaN guard (CollaborativeCallback.on_step_end semantics,
                 # albert/run_trainer.py:134-137): discard this update

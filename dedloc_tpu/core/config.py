@@ -57,14 +57,28 @@ DATASETS = Registry("dataset")
 SCHEDULES = Registry("schedule")
 
 
-def _add_dataclass_args(parser: argparse.ArgumentParser, cls: Type, prefix: str = ""):
+def _add_dataclass_args(
+    parser: argparse.ArgumentParser, cls: Type, prefix: str = "", defaults: Any = None
+):
     import typing
 
+    # Defaults come from an INSTANCE of cls so that a parent's
+    # default_factory override (e.g. SwAVCollaborationArguments setting
+    # target_batch_size=32768 on its optimizer field) survives into the CLI
+    # defaults instead of being shadowed by the nested class's own field
+    # defaults.
+    if defaults is None:
+        defaults = cls()
     hints = typing.get_type_hints(cls)
     for f in fields(cls):
         ftype = hints.get(f.name, f.type)
         if is_dataclass(ftype):
-            _add_dataclass_args(parser, ftype, prefix=f"{prefix}{f.name}.")
+            _add_dataclass_args(
+                parser,
+                ftype,
+                prefix=f"{prefix}{f.name}.",
+                defaults=getattr(defaults, f.name),
+            )
             continue
         name = f"--{prefix}{f.name}"
         origin = get_origin(ftype)
@@ -72,11 +86,7 @@ def _add_dataclass_args(parser: argparse.ArgumentParser, cls: Type, prefix: str 
             ftype = get_args(ftype)[0]
         elif origin is not None and type(None) in get_args(ftype):
             ftype = next(a for a in get_args(ftype) if a is not type(None))
-        default = (
-            f.default
-            if f.default is not dataclasses.MISSING
-            else (f.default_factory() if f.default_factory is not dataclasses.MISSING else None)
-        )
+        default = getattr(defaults, f.name)
         if ftype is bool:
             parser.add_argument(name, type=lambda s: s.lower() in ("1", "true", "yes"),
                                 default=default)
@@ -193,3 +203,45 @@ class CollaborationArguments:
     training: TrainingArguments = field(default_factory=TrainingArguments)
     wandb_project: Optional[str] = None
     bandwidth: float = 1000.0
+
+
+@dataclass
+class SwAVTrainingArguments:
+    """SwAV local-step recipe, mirroring swav_1node_resnet_submit.yaml
+    (:33-37,68,93-104) + sgd_collaborative.py:145-157."""
+
+    model_size: str = "resnet50"  # tiny (CI fixture) | resnet50
+    max_local_steps: int = 0  # accumulation boundaries to run (0 = forever)
+    per_device_batch_size: int = 8
+    gradient_accumulation_steps: int = 1
+    learning_rate: float = 0.3  # LARC-SGD base lr (defaults.yaml SwAV recipe)
+    momentum: float = 0.9
+    weight_decay: float = 1e-6
+    trust_coefficient: float = 0.001
+    warmup_steps: int = 500
+    total_steps: int = 100_000
+    queue_length: int = 0  # per-peer embedding queue (0 = off)
+    queue_start_step: int = 0  # global step gating use_queue (yaml :95)
+    seed: int = 0
+    output_dir: str = "outputs_swav"
+    save_steps: int = 0
+    save_total_limit: int = 2
+    log_every: int = 10
+
+
+@dataclass
+class SwAVCollaborationArguments:
+    """Argument tree for the SwAV collaborative driver (the fork's
+    SGDCollaborative defaults: target_batch_size 32768,
+    sgd_collaborative.py:153)."""
+
+    dht: DHTArguments = field(default_factory=DHTArguments)
+    averager: AveragerArguments = field(default_factory=AveragerArguments)
+    optimizer: CollaborativeOptimizerArguments = field(
+        default_factory=lambda: CollaborativeOptimizerArguments(
+            target_batch_size=32768
+        )
+    )
+    training: SwAVTrainingArguments = field(
+        default_factory=SwAVTrainingArguments
+    )

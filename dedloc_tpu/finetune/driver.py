@@ -9,7 +9,6 @@ static-shape batches, per-epoch evaluation, best-params restore.
 from __future__ import annotations
 
 import dataclasses
-import functools
 import logging
 from typing import Callable, Dict, Optional, Tuple
 
@@ -89,10 +88,10 @@ def _batches(data: Dict[str, np.ndarray], batch_size: int, rng: np.random.Genera
         yield {k: v[idx] for k, v in data.items()}
 
 
-@functools.lru_cache(maxsize=8)
-def _make_eval_step(apply_fn: Callable):
-    """Jitted eval step, cached per apply_fn so repeated evaluate() calls
-    (one per epoch) reuse the compiled program."""
+def make_eval_step(apply_fn: Callable):
+    """Jitted eval step. Build ONCE per apply_fn and reuse across evaluate()
+    calls — caching on the closure identity (lru_cache) would never hit
+    across finetune() calls while pinning dead compiled programs."""
 
     @jax.jit
     def eval_step(params, batch):
@@ -115,9 +114,14 @@ def evaluate(
     params,
     data: Dict[str, np.ndarray],
     batch_size: int,
+    eval_step: Optional[Callable] = None,
 ) -> Tuple[float, np.ndarray]:
-    """Returns (mean masked loss, predictions over the full set, unshuffled)."""
-    eval_step = _make_eval_step(apply_fn)
+    """Returns (mean masked loss, predictions over the full set, unshuffled).
+
+    Pass a prebuilt ``eval_step`` (from ``make_eval_step``) to reuse one
+    compiled program across epochs."""
+    if eval_step is None:
+        eval_step = make_eval_step(apply_fn)
     n = len(data["input_ids"])
     preds = []
     total_loss = 0.0
@@ -193,6 +197,8 @@ def finetune(
     def apply_eval(params, ids, mask, types):
         return model.apply({"params": params}, ids, mask, types, deterministic=True)
 
+    eval_step = make_eval_step(apply_eval)  # one compile, reused every epoch
+
     @jax.jit
     def train_step(params, opt_state, batch, dropout_rng):
         dropout_rng, step_rng = jax.random.split(dropout_rng)
@@ -232,7 +238,8 @@ def finetune(
             train_loss += float(metrics["loss"])
             steps += 1
         eval_loss, preds = evaluate(
-            apply_eval, params, eval_data, args.per_device_batch_size
+            apply_eval, params, eval_data, args.per_device_batch_size,
+            eval_step=eval_step,
         )
         record = {
             "epoch": epoch,

@@ -37,13 +37,23 @@ def encode_ncc_examples(
     examples: Sequence[Dict],
     tokenize_text: Callable[[str], Sequence[int]],
     max_seq_length: int,
+    sep_token_id: Optional[int] = None,
 ) -> Dict[str, np.ndarray]:
-    """(text, label) pairs -> fixed-shape arrays for the pooled classifier."""
+    """(text, label) pairs -> fixed-shape arrays for the pooled classifier.
+
+    When truncating, the final position is rewritten to ``sep_token_id`` so
+    long inputs keep the ``[CLS] ... [SEP]`` layout the backbone was
+    pretrained on (HF truncation preserves special tokens the same way).
+    """
     ids = np.zeros((len(examples), max_seq_length), np.int32)
     mask = np.zeros_like(ids)
     labels = np.zeros((len(examples),), np.int32)
     for i, ex in enumerate(examples):
-        tok_ids = list(tokenize_text(ex["text"]))[:max_seq_length]
+        tok_ids = list(tokenize_text(ex["text"]))
+        if len(tok_ids) > max_seq_length:
+            tok_ids = tok_ids[:max_seq_length]
+            if sep_token_id is not None:
+                tok_ids[-1] = sep_token_id
         ids[i, : len(tok_ids)] = tok_ids
         mask[i, : len(tok_ids)] = 1
         labels[i] = int(ex["label"])
@@ -69,9 +79,16 @@ def run_ncc(
     tokenize_text: Callable[[str], Sequence[int]],
     init_params=None,
     label_list: Sequence[str] = SNA_BN_LABELS,
+    sep_token_id: Optional[int] = None,
 ):
-    train_data = encode_ncc_examples(train_examples, tokenize_text, args.max_seq_length)
-    eval_data = encode_ncc_examples(eval_examples, tokenize_text, args.max_seq_length)
+    train_data = encode_ncc_examples(
+        train_examples, tokenize_text, args.max_seq_length,
+        sep_token_id=sep_token_id,
+    )
+    eval_data = encode_ncc_examples(
+        eval_examples, tokenize_text, args.max_seq_length,
+        sep_token_id=sep_token_id,
+    )
     model = AlbertForSequenceClassification(
         model_cfg, num_labels=len(label_list),
         classifier_dropout=args.train.classifier_dropout,
@@ -102,6 +119,7 @@ def main(argv=None) -> None:
         list(ds["validation"]),
         tok.encode_ids,
         init_params=init_params,
+        sep_token_id=tok.sep_id,
     )
     logger.info("NCC final: %s", history[-1] if history else {})
 

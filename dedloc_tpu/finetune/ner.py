@@ -42,12 +42,15 @@ def encode_ner_examples(
     tokenize_words: Callable[[List[str]], Dict],
     max_seq_length: int,
     label_all_tokens: bool = False,
+    sep_token_id: Optional[int] = None,
 ) -> Dict[str, np.ndarray]:
     """Word lists + word-level tags -> fixed-shape model arrays.
 
     ``tokenize_words(words)`` must return {"input_ids", "word_ids"} (the
     is_split_into_words tokenizer contract of train_ner.py:184-191); output is
-    padded/truncated to ``max_seq_length``.
+    padded/truncated to ``max_seq_length``. When truncating, the final
+    position becomes ``sep_token_id`` (word_id None, label -100) so long
+    inputs keep the pretrained ``[CLS] ... [SEP]`` layout.
     """
     ids = np.zeros((len(examples), max_seq_length), np.int32)
     mask = np.zeros_like(ids)
@@ -56,6 +59,9 @@ def encode_ner_examples(
         enc = tokenize_words(list(ex["tokens"]))
         tok_ids = list(enc["input_ids"])[:max_seq_length]
         word_ids = list(enc["word_ids"])[:max_seq_length]
+        if len(enc["input_ids"]) > max_seq_length and sep_token_id is not None:
+            tok_ids[-1] = sep_token_id
+            word_ids[-1] = None
         lab = align_labels_with_words(word_ids, ex["ner_tags"], label_all_tokens)
         ids[i, : len(tok_ids)] = tok_ids
         mask[i, : len(tok_ids)] = 1
@@ -89,14 +95,17 @@ def run_ner(
     tokenize_words: Callable[[List[str]], Dict],
     init_params=None,
     label_list: Sequence[str] = WIKIANN_LABELS,
+    sep_token_id: Optional[int] = None,
 ):
     """Returns (best_params, history). Injectable data/tokenizer for offline
     tests; the CLI main wires wikiann/bn + the trained tokenizer."""
     train_data = encode_ner_examples(
-        train_examples, tokenize_words, args.max_seq_length, args.label_all_tokens
+        train_examples, tokenize_words, args.max_seq_length,
+        args.label_all_tokens, sep_token_id=sep_token_id,
     )
     eval_data = encode_ner_examples(
-        eval_examples, tokenize_words, args.max_seq_length, args.label_all_tokens
+        eval_examples, tokenize_words, args.max_seq_length,
+        args.label_all_tokens, sep_token_id=sep_token_id,
     )
     model = AlbertForTokenClassification(
         model_cfg, num_labels=len(label_list),
@@ -158,6 +167,7 @@ def main(argv=None) -> None:
         eval_examples,
         tok.tokenize_words,
         init_params=init_params,
+        sep_token_id=tok.sep_id,
     )
     logger.info("NER final: %s", history[-1] if history else {})
 

@@ -352,3 +352,61 @@ def make_swav_train_step(model: SwAVModel, cfg: SwAVConfig, tx):
         )
 
     return jax.jit(train_step, static_argnums=(2,), donate_argnums=(0,))
+
+
+def make_swav_accumulate_step(model: SwAVModel, cfg: SwAVConfig):
+    """Collaborative variant: per micro-batch grad accumulation (the shape
+    CollaborativeOptimizer.step consumes, like make_accumulate_step for
+    ALBERT). BN statistics and the queue are LOCAL per-peer state (exactly as
+    in the reference, where the queue lives per-GPU in the loss and BN stats
+    per node), so they update every micro-batch; gradients accumulate for the
+    collaboration-wide step. The prototype freeze mask keys off the GLOBAL
+    step (fork seam capability, standard_train_step.py:153) — zeroing is
+    linear, so masking per micro-batch equals masking the averaged grads.
+
+    Returns jitted (params, batch_stats, queue, grad_acc, n_acc, crops,
+    global_step, use_queue) -> (grad_acc', n_acc', batch_stats', queue',
+    metrics)."""
+
+    def step(params, batch_stats, queue, grad_acc, n_acc, crops, global_step,
+             use_queue: bool):
+        queue_scores = (
+            queue.scores(params["head"], cfg)
+            if (use_queue and queue is not None)
+            else None
+        )
+
+        def loss_fn(p):
+            (emb, scores), mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                crops,
+                True,
+                mutable=["batch_stats"],
+            )
+            loss = swav_loss(scores, cfg, queue_scores, use_queue=use_queue)
+            return loss, (mutated["batch_stats"], emb)
+
+        (loss, (new_bn, emb)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        grads = freeze_prototypes_grads(
+            grads, global_step, cfg.freeze_prototypes_steps
+        )
+        grad_acc = jax.tree.map(
+            lambda a, g: a + g.astype(jnp.float32), grad_acc, grads
+        )
+        new_queue = queue.update(emb, cfg) if queue is not None else None
+        return grad_acc, n_acc + 1, new_bn, new_queue, {"loss": loss}
+
+    return jax.jit(step, static_argnums=(7,), donate_argnums=(3, 4))
+
+
+def make_prototype_post_apply():
+    """Jitted TrainState -> TrainState re-normalizing prototypes after every
+    global optimizer update (NormalizePrototypesHook.on_update capability) —
+    plugs into CollaborativeOptimizer(post_apply=...)."""
+
+    def post(state):
+        return state.replace(params=normalize_prototypes(state.params))
+
+    return jax.jit(post, donate_argnums=(0,))

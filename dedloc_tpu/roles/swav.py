@@ -1,0 +1,203 @@
+"""SwAV collaborative trainer peer.
+
+Capability parity with the reference's swav workload driver (reference:
+swav/vissl/vissl/trainer/trainer_main.py:138-204 phase loop +
+swav/ClassyVision/classy_vision/optim/sgd_collaborative.py:132-171): build
+ResNet-50 trunk + prototypes head, LARC-SGD with warmup-cosine schedule,
+DHT + CollaborativeOptimizer (target_batch_size 32768), multicrop pipeline,
+and run the phase-loop Trainer with the default hook pipeline.
+
+TPU-native shape (SURVEY.md §3.4): the reference's two communication worlds —
+NCCL all_reduce inside the sinkhorn loop and hivemind averaging per optimizer
+step — become (a) ICI psums XLA inserts when the jitted step is sharded over
+a mesh and (b) the DHT/DCN averaging in CollaborativeOptimizer. The GLOBAL
+collaboration step (not the local one) gates the queue and the prototype
+freeze, exactly as the fork feeds collaboration_state.optimizer_step to the
+loss (standard_train_step.py:153).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dedloc_tpu.collaborative.optimizer import CollaborativeOptimizer
+from dedloc_tpu.core.config import SwAVCollaborationArguments, parse_config
+from dedloc_tpu.core.hooks import default_hooks
+from dedloc_tpu.core.trainer import Trainer
+from dedloc_tpu.data.multicrop import MultiCropSpec, synthetic_multicrop_batches
+from dedloc_tpu.models.swav import (
+    SwAVConfig,
+    SwAVModel,
+    SwAVQueue,
+    make_prototype_post_apply,
+    make_swav_accumulate_step,
+)
+from dedloc_tpu.optim.lars import lars
+from dedloc_tpu.optim.schedules import linear_warmup_cosine_annealing
+from dedloc_tpu.parallel.train_step import TrainState, zeros_like_grads
+from dedloc_tpu.roles.common import build_dht, force_cpu_if_requested
+from dedloc_tpu.utils.checkpoint import save_checkpoint
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def build_swav(args: SwAVCollaborationArguments):
+    """(cfg, spec, model, tx) for the requested model size."""
+    t = args.training
+    if t.model_size == "tiny":
+        cfg = SwAVConfig.tiny(
+            queue_length=t.queue_length, queue_start_step=t.queue_start_step
+        )
+        spec = MultiCropSpec.tiny()
+    else:
+        cfg = SwAVConfig(
+            queue_length=t.queue_length, queue_start_step=t.queue_start_step
+        )
+        spec = MultiCropSpec()
+    model = SwAVModel(cfg)
+    schedule = linear_warmup_cosine_annealing(
+        t.learning_rate, t.warmup_steps, t.total_steps
+    )
+    tx = lars(
+        learning_rate=schedule,
+        momentum=t.momentum,
+        weight_decay=t.weight_decay,
+        trust_coefficient=t.trust_coefficient,
+    )
+    return cfg, spec, model, tx
+
+
+def run_swav(args: SwAVCollaborationArguments) -> TrainState:
+    force_cpu_if_requested()
+    t = args.training
+    cfg, spec, model, tx = build_swav(args)
+    dht, _public_key = build_dht(args)
+    logger.info(f"swav peer DHT listening on {dht.port}")
+
+    rng = jax.random.PRNGKey(t.seed)
+    init_crops = [
+        jnp.zeros((count * t.per_device_batch_size, size, size, spec.channels))
+        for size, count in zip(spec.sizes, spec.counts)
+    ]
+    variables = model.init(rng, init_crops, True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    state = jax.jit(lambda p: TrainState.create(p, tx))(params)
+    queue = (
+        SwAVQueue.create(cfg, jax.random.PRNGKey(t.seed + 1))
+        if cfg.queue_length
+        else None
+    )
+
+    opt = CollaborativeOptimizer(
+        tx,
+        dht,
+        prefix=args.dht.experiment_prefix,
+        target_batch_size=args.optimizer.target_batch_size,
+        batch_size_per_step=(
+            t.per_device_batch_size * t.gradient_accumulation_steps
+        ),
+        bandwidth=args.averager.bandwidth,
+        compression=args.averager.compression,
+        target_group_size=args.averager.target_group_size,
+        averaging_expiration=args.averager.averaging_expiration,
+        averaging_timeout=args.averager.averaging_timeout,
+        metadata_expiration=args.averager.metadata_expiration,
+        statistics_expiration=args.optimizer.statistics_expiration,
+        client_mode=args.dht.client_mode,
+        post_apply=make_prototype_post_apply(),
+        verbose=True,
+    )
+    state = opt.load_state_from_peers(state)
+
+    accumulate = make_swav_accumulate_step(model, cfg)
+    grad_acc = zeros_like_grads(state.params)
+    n_acc = jnp.zeros([], jnp.int32)
+    batches = synthetic_multicrop_batches(
+        spec, t.per_device_batch_size, seed=t.seed
+    )
+    samples = t.per_device_batch_size * t.gradient_accumulation_steps
+
+    # mutable local (non-collaborative) state, closed over by the step fn
+    local = {"batch_stats": batch_stats, "queue": queue,
+             "grad_acc": grad_acc, "n_acc": n_acc}
+
+    def step_fn(state, micro_batches: List[List[np.ndarray]]):
+        # one trainer step = one accumulation boundary
+        loss = jnp.zeros([])
+        for crops in micro_batches:
+            use_queue = bool(
+                cfg.queue_length and opt.local_step >= cfg.queue_start_step
+            )
+            local["grad_acc"], local["n_acc"], local["batch_stats"], \
+                local["queue"], metrics = accumulate(
+                    state.params,
+                    local["batch_stats"],
+                    local["queue"],
+                    local["grad_acc"],
+                    local["n_acc"],
+                    [jnp.asarray(c) for c in crops],
+                    jnp.asarray(opt.local_step, jnp.int32),
+                    use_queue,
+                )
+            loss = metrics["loss"]
+        state, local["grad_acc"], local["n_acc"], _stepped = opt.step(
+            state, local["grad_acc"], local["n_acc"], samples
+        )
+        return state, {"loss": loss, "global_step": opt.local_step}
+
+    def grouped(it: Iterator, k: int) -> Iterator[list]:
+        while True:
+            group = []
+            for _ in range(k):
+                try:
+                    group.append(next(it))
+                except StopIteration:
+                    # PEP 479: returning (not leaking StopIteration) ends the
+                    # generator so Trainer stops gracefully on finite data
+                    return
+            yield group
+
+    def save_fn(ctx):
+        host = jax.device_get(
+            (ctx.train_state.params, local["batch_stats"])
+        )
+        from dedloc_tpu.collaborative.optimizer import _tree_to_named
+
+        save_checkpoint(
+            t.output_dir,
+            opt.local_step,
+            _tree_to_named(host),
+            metadata={"local_step": opt.local_step},
+            save_total_limit=t.save_total_limit,
+        )
+
+    trainer = Trainer(
+        step_fn,
+        hooks=default_hooks(
+            log_every=t.log_every,
+            save_fn=save_fn if t.save_steps else None,
+            save_every=t.save_steps,
+        ),
+    )
+    try:
+        state, _ctx = trainer.train(
+            state,
+            grouped(batches, t.gradient_accumulation_steps),
+            max_steps=t.max_local_steps or 10**9,
+        )
+    finally:
+        opt.shutdown()
+        dht.shutdown()
+    return state
+
+
+def main(argv=None) -> None:
+    run_swav(parse_config(SwAVCollaborationArguments, argv))
+
+
+if __name__ == "__main__":
+    main()

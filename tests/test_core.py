@@ -97,3 +97,17 @@ def test_parse_config_overrides():
     assert cfg.optimizer.target_batch_size == 128
     assert cfg.dht.initial_peers == ["a:1", "b:2"]
     assert cfg.dht.client_mode is True
+
+
+def test_parse_config_respects_parent_default_factory_overrides():
+    # SwAVCollaborationArguments overrides its optimizer field's
+    # target_batch_size via default_factory (32768, sgd_collaborative.py:153)
+    # — parse_config must honor it, not the nested class's own default.
+    from dedloc_tpu.core.config import SwAVCollaborationArguments
+
+    args = parse_config(SwAVCollaborationArguments, [])
+    assert args.optimizer.target_batch_size == 32768
+    args = parse_config(
+        SwAVCollaborationArguments, ["--optimizer.target_batch_size", "64"]
+    )
+    assert args.optimizer.target_batch_size == 64

@@ -178,3 +178,31 @@ def test_finetune_warm_start_uses_pretrained_backbone(rng):
     best, _ = finetune(model, {"albert": marker}, data, data, args)
     leaf = jax.tree_util.tree_leaves(best["albert"])[0]
     assert np.allclose(np.asarray(leaf), 0.123)
+
+
+def test_encode_truncation_preserves_sep():
+    from dedloc_tpu.finetune.ncc import encode_ncc_examples
+    from dedloc_tpu.finetune.ner import encode_ner_examples
+
+    SEP = 3
+    # NCC: 10 tokens into max_seq 6 -> last kept position rewritten to [SEP]
+    data = encode_ncc_examples(
+        [{"text": "x", "label": 1}],
+        lambda text: [2, 10, 11, 12, 13, 14, 15, 16, 17, SEP],
+        max_seq_length=6,
+        sep_token_id=SEP,
+    )
+    assert data["input_ids"][0, 5] == SEP
+    assert data["attention_mask"][0].sum() == 6
+
+    # NER: truncated tail becomes [SEP] with label -100
+    enc = {"input_ids": [2, 10, 11, 12, 13, SEP],
+           "word_ids": [None, 0, 1, 2, 3, None]}
+    data = encode_ner_examples(
+        [{"tokens": ["a", "b", "c", "d"], "ner_tags": [1, 2, 3, 4]}],
+        lambda words: enc,
+        max_seq_length=4,
+        sep_token_id=SEP,
+    )
+    assert data["input_ids"][0, 3] == SEP
+    assert data["labels"][0, 3] == -100

@@ -1,0 +1,128 @@
+"""Hook pipeline + perf stats (vissl hooks/perf_stats capability)."""
+import math
+import time
+
+import pytest
+
+from dedloc_tpu.core.hooks import (
+    CheckNanLossHook,
+    CheckpointHook,
+    Hook,
+    HookList,
+    LogLossLrEtaHook,
+    LoopContext,
+    MetricsPublisherHook,
+    default_hooks,
+)
+from dedloc_tpu.utils.perf import PerfStats, profiler_trace
+
+
+class Recorder(Hook):
+    def __init__(self):
+        self.events = []
+
+    def __getattribute__(self, name):
+        if name.startswith("on_"):
+            return lambda ctx: object.__getattribute__(self, "events").append(name)
+        return object.__getattribute__(self, name)
+
+
+def test_dispatch_order_and_events():
+    r1, r2 = Recorder(), Recorder()
+    hooks = HookList([r1, r2])
+    ctx = LoopContext()
+    for ev in ("on_start", "on_step_begin", "on_loss", "on_step_end", "on_end"):
+        hooks.dispatch(ev, ctx)
+    assert r1.events == r2.events == [
+        "on_start", "on_step_begin", "on_loss", "on_step_end", "on_end",
+    ]
+
+
+def test_dispatch_rejects_unknown_event():
+    with pytest.raises(ValueError):
+        HookList().dispatch("on_banana", LoopContext())
+
+
+def test_nan_loss_hook_raises():
+    hook = CheckNanLossHook()
+    ctx = LoopContext(loss=1.0)
+    hook.on_loss(ctx)  # finite: fine
+    ctx.loss = float("nan")
+    with pytest.raises(FloatingPointError):
+        hook.on_loss(ctx)
+    ctx.loss = float("inf")
+    with pytest.raises(FloatingPointError):
+        hook.on_loss(ctx)
+
+
+def test_checkpoint_hook_cadence():
+    saves = []
+    hook = CheckpointHook(lambda ctx: saves.append(ctx.local_step), every=3)
+    ctx = LoopContext()
+    for step in range(1, 8):
+        ctx.local_step = step
+        hook.on_step_end(ctx)
+    hook.on_phase_end(ctx)
+    assert saves == [3, 6, 7]  # every-3 plus phase-end
+
+
+def test_metrics_publisher_fires_on_global_step_advance():
+    published = []
+    hook = MetricsPublisherHook(lambda ctx: published.append(ctx.global_step))
+    ctx = LoopContext()
+    for local, global_ in [(1, 0), (2, 0), (3, 1), (4, 1), (5, 2)]:
+        ctx.local_step, ctx.global_step = local, global_
+        hook.on_step_end(ctx)
+    assert published == [0, 1, 2]
+
+
+def test_default_hooks_compose():
+    hooks = default_hooks(save_fn=lambda ctx: None, save_every=10)
+    assert len(hooks.hooks) == 4
+    ctx = LoopContext(loss=0.5, local_step=10, max_steps=100)
+    hooks.dispatch("on_phase_start", ctx)
+    hooks.dispatch("on_loss", ctx)
+    hooks.dispatch("on_step_end", ctx)
+
+
+def test_perf_stats_timers():
+    stats = PerfStats()
+    for _ in range(3):
+        with stats.timer("phase_a"):
+            time.sleep(0.003)
+    s = stats.report()["phase_a"]
+    assert s["count"] == 3
+    assert s["mean_ms"] >= 2.0
+    assert s["min_ms"] <= s["mean_ms"] <= s["max_ms"] + 1e-9
+    assert "phase_a" in stats.report_str()
+
+
+def test_perf_stats_block_on_jax_array():
+    import jax.numpy as jnp
+
+    stats = PerfStats()
+    with stats.timer("step", block_on=jnp.ones((8, 8)) @ jnp.ones((8, 8))):
+        pass
+    assert stats.report()["step"]["count"] == 1
+
+
+def test_perf_stats_disabled_is_noop():
+    stats = PerfStats(enabled=False)
+    with stats.timer("x"):
+        pass
+    assert stats.report() == {}
+
+
+def test_profiler_trace_noop_without_dir():
+    with profiler_trace(None):
+        pass
+    with profiler_trace(""):
+        pass
+
+
+def test_profiler_trace_writes(tmp_path):
+    import jax.numpy as jnp
+
+    with profiler_trace(str(tmp_path)):
+        (jnp.ones((4, 4)) * 2).block_until_ready()
+    assert any(tmp_path.rglob("*"))  # xplane artifacts written
