@@ -1,0 +1,279 @@
+"""Token authorization for gated public collaborations.
+
+Capability parity with the reference's auth stack
+(sahajbert/huggingface_auth.py:46-171): a peer authenticates to an authority
+with its credentials, submits its local RSA public key, and receives a
+signed ``AccessToken`` (username + peer public key + expiration, signed by
+the authority) plus the coordinator endpoint; the token then rides on every
+peer-to-peer request, letting any peer verify that its counterparty was
+admitted to the run without talking to the authority again. The client
+implements the reference's ``TokenAuthorizerBase`` protocol surface:
+``get_token`` / ``is_token_valid`` / ``does_token_need_refreshing``.
+
+TPU-native descope: the reference's authority is an HTTPS service
+(collaborative-training-auth.huggingface.co) reached through huggingface_hub
+login; here the authority is an in-process/object seam
+(``AllowlistAuthServer``) a deployment can put behind any transport. The
+cryptography (RSA-PSS over a canonical token encoding) is the load-bearing
+part and is identical in capability.
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import hmac
+import random
+from typing import Awaitable, Callable, Dict, Optional, TypeVar
+
+from dedloc_tpu.core.timeutils import get_dht_time
+from dedloc_tpu.dht.crypto import RSAPrivateKey, verify_signature
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+T = TypeVar("T")
+
+
+@dataclasses.dataclass
+class AccessToken:
+    """Signed admission ticket (reference: the AccessToken the auth endpoint
+    returns, huggingface_auth.py:46-76 consumption sites)."""
+
+    username: str
+    peer_public_key: bytes  # DER SubjectPublicKeyInfo of the admitted peer
+    expiration_time: float  # DHT time
+    signature: bytes = b""
+
+    def signing_bytes(self) -> bytes:
+        """Canonical byte encoding covered by the authority's signature."""
+        return b" ".join(
+            [
+                self.username.encode(),
+                self.peer_public_key.hex().encode(),
+                repr(float(self.expiration_time)).encode(),
+            ]
+        )
+
+    def to_wire(self) -> Dict:
+        return {
+            "username": self.username,
+            "peer_public_key": self.peer_public_key,
+            "expiration_time": self.expiration_time,
+            "signature": self.signature,
+        }
+
+    @classmethod
+    def from_wire(cls, raw: Dict) -> "AccessToken":
+        return cls(
+            username=str(raw["username"]),
+            peer_public_key=bytes(raw["peer_public_key"]),
+            expiration_time=float(raw["expiration_time"]),
+            signature=bytes(raw["signature"]),
+        )
+
+
+class AuthorizationError(Exception):
+    """Raised when the authority rejects a peer or a token fails checks."""
+
+
+class AllowlistAuthServer:
+    """In-process authority: allowlist + credential check -> signed tokens.
+
+    Stand-in for the reference's moderated auth service (the sahajbert run
+    gated contributors through an HF-account allowlist). Holds the authority
+    keypair; deployments expose ``issue_token`` over any transport.
+    """
+
+    def __init__(
+        self,
+        allowlist: Dict[str, str],  # username -> credential (password/API key)
+        token_lifetime: float = 600.0,
+        coordinator_endpoint: Optional[str] = None,
+        authority_key: Optional[RSAPrivateKey] = None,
+    ):
+        self._allowlist = dict(allowlist)
+        self.token_lifetime = token_lifetime
+        self.coordinator_endpoint = coordinator_endpoint
+        self._key = authority_key or RSAPrivateKey()
+
+    @property
+    def authority_public_key(self) -> bytes:
+        return self._key.public_bytes()
+
+    def add_user(self, username: str, credential: str) -> None:
+        self._allowlist[username] = credential
+
+    def revoke_user(self, username: str) -> None:
+        self._allowlist.pop(username, None)
+
+    def issue_token(
+        self, username: str, credential: str, peer_public_key: bytes
+    ) -> Dict:
+        """Returns {"token": wire-token, "coordinator_endpoint": ...} or
+        raises AuthorizationError (non-allowlisted / bad credential)."""
+        expected = self._allowlist.get(username)
+        if (
+            credential is None
+            or expected is None
+            or not hmac.compare_digest(expected, credential)
+        ):
+            raise AuthorizationError(f"user {username!r} is not authorized")
+        token = AccessToken(
+            username=username,
+            peer_public_key=peer_public_key,
+            expiration_time=get_dht_time() + self.token_lifetime,
+        )
+        token.signature = self._key.sign(token.signing_bytes())
+        return {
+            "token": token.to_wire(),
+            "coordinator_endpoint": self.coordinator_endpoint,
+        }
+
+
+class TokenAuthorizerBase:
+    """The reference's authorizer protocol (hivemind TokenAuthorizerBase as
+    implemented by HuggingFaceAuthorizer, huggingface_auth.py:46-143):
+    subclasses fetch tokens; this base owns validity/refresh logic and the
+    local keypair."""
+
+    def __init__(self, local_key: Optional[RSAPrivateKey] = None):
+        self.local_private_key = local_key or RSAPrivateKey()
+        self.local_public_key = self.local_private_key.public_bytes()
+        self._token: Optional[AccessToken] = None
+
+    async def get_token(self) -> AccessToken:
+        raise NotImplementedError
+
+    def is_token_valid(self, token: AccessToken) -> bool:
+        raise NotImplementedError
+
+    def does_token_need_refreshing(
+        self, token: AccessToken, refresh_margin: float = 30.0
+    ) -> bool:
+        return get_dht_time() + refresh_margin >= token.expiration_time
+
+    async def refresh_token_if_needed(self) -> AccessToken:
+        if self._token is None or self.does_token_need_refreshing(self._token):
+            self._token = await self.get_token()
+            if not self.is_token_valid(self._token):
+                raise AuthorizationError("authority returned an invalid token")
+        return self._token
+
+
+class AllowlistAuthorizer(TokenAuthorizerBase):
+    """Client against an ``AllowlistAuthServer``-shaped authority.
+
+    ``issue_fn(username, credential, peer_public_key)`` is the transport
+    seam: the in-process server's ``issue_token`` in tests, an HTTPS call in
+    a deployment.
+    """
+
+    def __init__(
+        self,
+        username: str,
+        credential: str,
+        issue_fn: Callable[[str, str, bytes], Dict],
+        authority_public_key: bytes,
+        local_key: Optional[RSAPrivateKey] = None,
+    ):
+        super().__init__(local_key)
+        self.username = username
+        self._credential = credential
+        self._issue_fn = issue_fn
+        self.authority_public_key = authority_public_key
+        self.coordinator_endpoint: Optional[str] = None
+
+    async def get_token(self) -> AccessToken:
+        response = await call_with_retries(
+            lambda: _maybe_async(
+                self._issue_fn, self.username, self._credential,
+                self.local_public_key,
+            ),
+            retryable=(OSError, TimeoutError),
+        )
+        self.coordinator_endpoint = response.get("coordinator_endpoint")
+        return AccessToken.from_wire(response["token"])
+
+    def is_token_valid(self, token: AccessToken) -> bool:
+        if token.expiration_time < get_dht_time():
+            return False
+        # the token must be bound to THIS peer — a validly-signed token for
+        # another peer's key would pass signature checks but every envelope
+        # we sign would then be rejected by counterparties
+        if token.username != self.username:
+            return False
+        if token.peer_public_key != self.local_public_key:
+            return False
+        if not verify_signature(
+            self.authority_public_key, token.signing_bytes(), token.signature
+        ):
+            return False
+        return True
+
+
+# ------------------------------------------------------- request envelopes
+
+
+def wrap_request(token: AccessToken, payload: bytes, sender_key: RSAPrivateKey) -> Dict:
+    """Signed request envelope: the token proves admission (authority
+    signature), the payload signature proves the sender owns the key the
+    token admits (hivemind AuthRPCWrapper capability)."""
+    return {
+        "token": token.to_wire(),
+        "payload": payload,
+        "payload_signature": sender_key.sign(payload),
+    }
+
+
+def unwrap_request(
+    envelope: Dict, authority_public_key: bytes, now: Optional[float] = None
+) -> bytes:
+    """Validate an envelope and return its payload, or raise
+    AuthorizationError. Checks: token signature (authority), token expiry,
+    payload signature by the token's peer key."""
+    token = AccessToken.from_wire(envelope["token"])
+    if not verify_signature(
+        authority_public_key, token.signing_bytes(), token.signature
+    ):
+        raise AuthorizationError("token signature invalid")
+    if token.expiration_time < (now if now is not None else get_dht_time()):
+        raise AuthorizationError("token expired")
+    payload = bytes(envelope["payload"])
+    if not verify_signature(
+        token.peer_public_key, payload, bytes(envelope["payload_signature"])
+    ):
+        raise AuthorizationError("payload signature invalid")
+    return payload
+
+
+# ---------------------------------------------------------------- retries
+
+
+async def call_with_retries(
+    fn: Callable[[], Awaitable[T]],
+    n_retries: int = 3,
+    base_delay: float = 0.5,
+    retryable: tuple = (Exception,),
+) -> T:
+    """Exponential backoff with jitter (the reference's retry helper around
+    the auth endpoint, huggingface_auth.py:23-35)."""
+    for attempt in range(n_retries + 1):
+        try:
+            return await fn()
+        except retryable as e:
+            if attempt == n_retries:
+                raise
+            delay = base_delay * (2 ** attempt) * (0.5 + random.random())
+            logger.warning(
+                f"auth call failed ({e!r}); retry {attempt + 1}/{n_retries} "
+                f"in {delay:.1f}s"
+            )
+            await asyncio.sleep(delay)
+    raise AssertionError("unreachable")
+
+
+async def _maybe_async(fn, *args):
+    result = fn(*args)
+    if asyncio.iscoroutine(result):
+        return await result
+    return result

@@ -49,8 +49,10 @@ class AlbertConfig:
     # activations (min HBM), "dots" saves matmul outputs (fewer recomputed
     # MXU ops when HBM allows)
     remat_policy: str = "nothing"
-    # "dense" (materialized S² scores) or "blockwise" (online-softmax over KV
-    # blocks, O(S·block) memory — the long-context path; exact, not approx)
+    # "dense" (materialized S² scores), "blockwise" (online-softmax over KV
+    # blocks via lax.scan, O(S·block) memory — the long-context path), or
+    # "flash" (the same math as ONE fused Pallas kernel with a custom-VJP
+    # backward: scores never leave VMEM; interpret-mode off TPU). All exact.
     attention_impl: str = "dense"
     attention_block_size: int = 512
 
@@ -102,10 +104,29 @@ class AlbertSelfAttention(nn.Module):
         k = split_heads(_dense(cfg.hidden_size, cfg, "key")(hidden))
         v = split_heads(_dense(cfg.hidden_size, cfg, "value")(hidden))
 
-        if cfg.attention_impl == "blockwise":
+        if cfg.attention_impl in ("flash", "blockwise") and (
+            cfg.attention_dropout_prob > 0.0
+        ):
+            raise ValueError(
+                f"attention_impl={cfg.attention_impl!r} does not support "
+                "attention dropout (the reference recipe uses 0.0); use "
+                "attention_impl='dense' or set attention_dropout_prob=0.0"
+            )
+        if cfg.attention_impl == "flash":
+            # fused Pallas kernel: scores stay in VMEM, flash backward
+            # (attention dropout is 0.0 in the reference recipe, so the
+            # fused path loses nothing)
+            from dedloc_tpu.ops.flash_attention import flash_attention
+
+            kv_bias = attn_bias[:, 0, 0, :]  # additive [B, S_kv]
+            ctx = flash_attention(
+                q, k, v, kv_bias,
+                block_q=cfg.attention_block_size,
+                block_k=cfg.attention_block_size,
+            ).reshape(B, S, H)
+        elif cfg.attention_impl == "blockwise":
             # long-context path: exact online-softmax over KV blocks — never
-            # materializes the S×S score matrix (attention dropout is 0.0 in
-            # the reference recipe, so the fused path loses nothing)
+            # materializes the S×S score matrix
             from dedloc_tpu.parallel.ring_attention import blockwise_attention
 
             kv_bias = attn_bias[:, 0, 0, :]  # additive [B, S_kv]

@@ -1,0 +1,2 @@
+"""Pallas TPU kernels for hot ops."""
+from dedloc_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,150 @@
+"""Token authorization (huggingface_auth.py capability): grant, expiry,
+signature validation, allowlist gating, signed request envelopes."""
+import asyncio
+
+import pytest
+
+from dedloc_tpu.core.auth import (
+    AccessToken,
+    AllowlistAuthServer,
+    AllowlistAuthorizer,
+    AuthorizationError,
+    call_with_retries,
+    unwrap_request,
+    wrap_request,
+)
+from dedloc_tpu.core.timeutils import get_dht_time
+from dedloc_tpu.dht.crypto import RSAPrivateKey
+
+
+@pytest.fixture(scope="module")
+def server():
+    return AllowlistAuthServer(
+        {"alice": "s3cret", "bob": "hunter2"},
+        token_lifetime=600.0,
+        coordinator_endpoint="10.0.0.1:31337",
+    )
+
+
+def make_client(server, username="alice", credential="s3cret"):
+    return AllowlistAuthorizer(
+        username, credential, server.issue_token, server.authority_public_key
+    )
+
+
+def test_token_grant_and_validation(server):
+    client = make_client(server)
+    token = asyncio.run(client.refresh_token_if_needed())
+    assert token.username == "alice"
+    assert token.peer_public_key == client.local_public_key
+    assert client.is_token_valid(token)
+    assert not client.does_token_need_refreshing(token, refresh_margin=30.0)
+    assert client.coordinator_endpoint == "10.0.0.1:31337"
+
+
+def test_non_allowlisted_peer_rejected(server):
+    with pytest.raises(AuthorizationError):
+        asyncio.run(make_client(server, "mallory", "x").get_token())
+    with pytest.raises(AuthorizationError):  # wrong credential
+        asyncio.run(make_client(server, "alice", "wrong").get_token())
+
+
+def test_revoked_user_rejected():
+    server = AllowlistAuthServer({"carol": "pw"})
+    client = make_client(server, "carol", "pw")
+    asyncio.run(client.get_token())
+    server.revoke_user("carol")
+    with pytest.raises(AuthorizationError):
+        asyncio.run(client.get_token())
+
+
+def test_tampered_token_invalid(server):
+    client = make_client(server)
+    token = asyncio.run(client.get_token())
+    forged = AccessToken(
+        username="root",
+        peer_public_key=token.peer_public_key,
+        expiration_time=token.expiration_time,
+        signature=token.signature,
+    )
+    assert not client.is_token_valid(forged)
+
+
+def test_expired_token_invalid_and_refreshes():
+    server = AllowlistAuthServer({"alice": "pw"}, token_lifetime=-1.0)
+    client = make_client(server, "alice", "pw")
+    token = asyncio.run(client.get_token())
+    assert not client.is_token_valid(token)
+    assert client.does_token_need_refreshing(token)
+    # refresh_token_if_needed must reject an authority that only hands out
+    # expired tokens instead of caching one
+    with pytest.raises(AuthorizationError):
+        asyncio.run(client.refresh_token_if_needed())
+
+
+def test_request_envelope_roundtrip(server):
+    client = make_client(server)
+    token = asyncio.run(client.refresh_token_if_needed())
+    env = wrap_request(token, b"gradients-chunk-7", client.local_private_key)
+    payload = unwrap_request(env, server.authority_public_key)
+    assert payload == b"gradients-chunk-7"
+
+
+def test_request_envelope_rejects_wrong_sender(server):
+    client = make_client(server)
+    token = asyncio.run(client.refresh_token_if_needed())
+    impostor_key = RSAPrivateKey()  # signs with a key the token doesn't admit
+    env = wrap_request(token, b"evil", impostor_key)
+    with pytest.raises(AuthorizationError):
+        unwrap_request(env, server.authority_public_key)
+
+
+def test_request_envelope_rejects_tampered_payload(server):
+    client = make_client(server)
+    token = asyncio.run(client.refresh_token_if_needed())
+    env = wrap_request(token, b"honest", client.local_private_key)
+    env["payload"] = b"tampered"
+    with pytest.raises(AuthorizationError):
+        unwrap_request(env, server.authority_public_key)
+
+
+def test_request_envelope_rejects_expired_token(server):
+    client = make_client(server)
+    token = asyncio.run(client.refresh_token_if_needed())
+    env = wrap_request(token, b"late", client.local_private_key)
+    with pytest.raises(AuthorizationError):
+        unwrap_request(env, server.authority_public_key,
+                       now=get_dht_time() + 10_000.0)
+
+
+def test_call_with_retries_recovers_and_gives_up():
+    attempts = []
+
+    async def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    result = asyncio.run(
+        call_with_retries(flaky, n_retries=3, base_delay=0.001,
+                          retryable=(OSError,))
+    )
+    assert result == "ok" and len(attempts) == 3
+
+    async def always_down():
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        asyncio.run(
+            call_with_retries(always_down, n_retries=2, base_delay=0.001,
+                              retryable=(OSError,))
+        )
+
+
+def test_token_bound_to_this_peer(server):
+    # a validly-signed token for ANOTHER peer's key must not validate here
+    other = make_client(server, "bob", "hunter2")
+    other_token = asyncio.run(other.get_token())
+    client = make_client(server)
+    assert not client.is_token_valid(other_token)
