@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import hmac
+import os
 import random
 from typing import Awaitable, Callable, Dict, Optional, TypeVar
 
@@ -115,7 +116,10 @@ class AllowlistAuthServer:
         if (
             credential is None
             or expected is None
-            or not hmac.compare_digest(expected, credential)
+            # bytes comparison: compare_digest on str raises for non-ASCII
+            or not hmac.compare_digest(
+                expected.encode("utf-8"), credential.encode("utf-8")
+            )
         ):
             raise AuthorizationError(f"user {username!r} is not authorized")
         token = AccessToken(
@@ -214,35 +218,82 @@ class AllowlistAuthorizer(TokenAuthorizerBase):
 # ------------------------------------------------------- request envelopes
 
 
-def wrap_request(token: AccessToken, payload: bytes, sender_key: RSAPrivateKey) -> Dict:
+def _envelope_signing_bytes(payload: bytes, nonce: bytes, timestamp: float) -> bytes:
+    return payload + b"|" + nonce + b"|" + repr(float(timestamp)).encode()
+
+
+def wrap_request(
+    token: AccessToken, payload: bytes, sender_key: RSAPrivateKey
+) -> Dict:
     """Signed request envelope: the token proves admission (authority
-    signature), the payload signature proves the sender owns the key the
-    token admits (hivemind AuthRPCWrapper capability)."""
+    signature); the sender signature covers payload + a fresh nonce + a
+    timestamp, so a captured envelope cannot be replayed (hivemind's
+    AuthRPCWrapper includes per-request nonces for the same reason)."""
+    nonce = os.urandom(16)
+    timestamp = get_dht_time()
     return {
         "token": token.to_wire(),
         "payload": payload,
-        "payload_signature": sender_key.sign(payload),
+        "nonce": nonce,
+        "timestamp": timestamp,
+        "payload_signature": sender_key.sign(
+            _envelope_signing_bytes(payload, nonce, timestamp)
+        ),
     }
 
 
+class ReplayGuard:
+    """Remembers recently-seen nonces within the freshness window."""
+
+    def __init__(self, max_age: float = 60.0):
+        self.max_age = max_age
+        self._seen: Dict[bytes, float] = {}
+
+    def check_and_remember(self, nonce: bytes, now: float) -> bool:
+        """False if the nonce was already seen (replay). Expires old ones."""
+        for n, t in list(self._seen.items()):
+            if now - t > self.max_age:
+                del self._seen[n]
+        if nonce in self._seen:
+            return False
+        self._seen[nonce] = now
+        return True
+
+
 def unwrap_request(
-    envelope: Dict, authority_public_key: bytes, now: Optional[float] = None
+    envelope: Dict,
+    authority_public_key: bytes,
+    now: Optional[float] = None,
+    replay_guard: Optional[ReplayGuard] = None,
+    max_age: float = 60.0,
 ) -> bytes:
     """Validate an envelope and return its payload, or raise
     AuthorizationError. Checks: token signature (authority), token expiry,
-    payload signature by the token's peer key."""
+    sender signature over payload+nonce+timestamp, freshness (``max_age``),
+    and — when a ``replay_guard`` is supplied — nonce uniqueness."""
     token = AccessToken.from_wire(envelope["token"])
     if not verify_signature(
         authority_public_key, token.signing_bytes(), token.signature
     ):
         raise AuthorizationError("token signature invalid")
-    if token.expiration_time < (now if now is not None else get_dht_time()):
+    t_now = now if now is not None else get_dht_time()
+    if token.expiration_time < t_now:
         raise AuthorizationError("token expired")
     payload = bytes(envelope["payload"])
+    nonce = bytes(envelope["nonce"])
+    timestamp = float(envelope["timestamp"])
+    if abs(t_now - timestamp) > max_age:
+        raise AuthorizationError("request envelope is stale")
     if not verify_signature(
-        token.peer_public_key, payload, bytes(envelope["payload_signature"])
+        token.peer_public_key,
+        _envelope_signing_bytes(payload, nonce, timestamp),
+        bytes(envelope["payload_signature"]),
     ):
         raise AuthorizationError("payload signature invalid")
+    if replay_guard is not None and not replay_guard.check_and_remember(
+        nonce, t_now
+    ):
+        raise AuthorizationError("replayed request envelope")
     return payload
 
 

@@ -104,13 +104,17 @@ class AlbertSelfAttention(nn.Module):
         k = split_heads(_dense(cfg.hidden_size, cfg, "key")(hidden))
         v = split_heads(_dense(cfg.hidden_size, cfg, "value")(hidden))
 
-        if cfg.attention_impl in ("flash", "blockwise") and (
-            cfg.attention_dropout_prob > 0.0
+        if (
+            cfg.attention_impl in ("flash", "blockwise")
+            and cfg.attention_dropout_prob > 0.0
+            and not deterministic
         ):
+            # in deterministic (eval/serving) mode dropout is inactive, so a
+            # dense-trained model can still be served with the fused impls
             raise ValueError(
                 f"attention_impl={cfg.attention_impl!r} does not support "
-                "attention dropout (the reference recipe uses 0.0); use "
-                "attention_impl='dense' or set attention_dropout_prob=0.0"
+                "attention dropout in training (the reference recipe uses "
+                "0.0); use attention_impl='dense' or attention_dropout_prob=0"
             )
         if cfg.attention_impl == "flash":
             # fused Pallas kernel: scores stay in VMEM, flash backward

@@ -132,8 +132,16 @@ def _maybe_wandb(args: CollaborationArguments):
         return None
 
 
+@dataclass
+class CoordinatorCLIArguments(CollaborationArguments):
+    coordinator: CoordinatorExtraArguments = field(
+        default_factory=CoordinatorExtraArguments
+    )
+
+
 def main(argv=None) -> None:
-    run_coordinator(parse_config(CollaborationArguments, argv))
+    args = parse_config(CoordinatorCLIArguments, argv)
+    run_coordinator(args, args.coordinator)
 
 
 if __name__ == "__main__":

@@ -148,3 +148,34 @@ def test_token_bound_to_this_peer(server):
     other_token = asyncio.run(other.get_token())
     client = make_client(server)
     assert not client.is_token_valid(other_token)
+
+
+def test_request_envelope_rejects_replay(server):
+    from dedloc_tpu.core.auth import ReplayGuard
+
+    client = make_client(server)
+    token = asyncio.run(client.refresh_token_if_needed())
+    guard = ReplayGuard(max_age=60.0)
+    env = wrap_request(token, b"chunk", client.local_private_key)
+    assert unwrap_request(env, server.authority_public_key,
+                          replay_guard=guard) == b"chunk"
+    with pytest.raises(AuthorizationError, match="replayed"):
+        unwrap_request(env, server.authority_public_key, replay_guard=guard)
+
+
+def test_request_envelope_rejects_stale(server):
+    client = make_client(server)
+    token = asyncio.run(client.refresh_token_if_needed())
+    env = wrap_request(token, b"old", client.local_private_key)
+    with pytest.raises(AuthorizationError, match="stale"):
+        unwrap_request(env, server.authority_public_key,
+                       now=get_dht_time() + 120.0, max_age=60.0)
+
+
+def test_non_ascii_credentials():
+    server = AllowlistAuthServer({"josé": "contraseña"})
+    client = make_client(server, "josé", "contraseña")
+    token = asyncio.run(client.get_token())
+    assert token.username == "josé"
+    with pytest.raises(AuthorizationError):
+        asyncio.run(make_client(server, "josé", "wröng").get_token())

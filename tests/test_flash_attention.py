@@ -103,11 +103,19 @@ def test_albert_flash_impl_matches_dense(rng):
     )
 
 
-def test_flash_rejects_attention_dropout(rng):
+def test_flash_rejects_attention_dropout_in_training_only(rng):
     from dedloc_tpu.models.albert import AlbertConfig, AlbertForPreTraining
 
     cfg = AlbertConfig.tiny(attention_impl="flash", attention_dropout_prob=0.1)
     model = AlbertForPreTraining(cfg)
     ids = jnp.zeros((1, 64), jnp.int32)
+    # deterministic (eval/serving): dropout inactive — must work, so a
+    # dense-trained model can be served with the fused impl
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    model.apply({"params": params}, ids, deterministic=True)
+    # training mode: fused impls cannot apply attention dropout — fail loudly
     with pytest.raises(ValueError, match="attention dropout"):
-        model.init(jax.random.PRNGKey(0), ids)
+        model.apply(
+            {"params": params}, ids, deterministic=False,
+            rngs={"dropout": jax.random.PRNGKey(1)},
+        )
