@@ -141,6 +141,7 @@ class CollaborativeOptimizer:
         self._ema_started = False
         self.local_step = 0
         self.local_samples_accumulated = 0
+        self.mesh = mesh
         self._apply_fn = make_apply_step(tx, mesh=mesh)
         # post-update transform on the new state (e.g. SwAV prototype
         # re-normalization — NormalizePrototypesHook.on_update capability,
@@ -306,9 +307,18 @@ class CollaborativeOptimizer:
         (params, opt_state), step = self._last_good
         return state.replace(
             step=jax.numpy.asarray(step, jax.numpy.int32),
-            params=jax.device_put(params),
-            opt_state=jax.device_put(opt_state),
+            params=self._device_put(params),
+            opt_state=self._device_put(opt_state),
         )
+
+    def _device_put(self, tree):
+        """Host tree -> devices, committed onto the slice mesh (replicated)
+        when one exists so accumulate doesn't re-broadcast per micro-batch."""
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.device_put(tree, NamedSharding(self.mesh, P()))
+        return jax.device_put(tree)
 
     def load_state_from_peers(self, state: TrainState) -> TrainState:
         """Download the newest collaboration state (params+opt) from a peer
@@ -328,8 +338,8 @@ class CollaborativeOptimizer:
         self.local_step = int(metadata.get("local_step", metadata.get("step", 0)))
         new_state = state.replace(
             step=jax.numpy.asarray(int(metadata.get("step", 0)), jax.numpy.int32),
-            params=jax.device_put(params),
-            opt_state=jax.device_put(opt_state),
+            params=self._device_put(params),
+            opt_state=self._device_put(opt_state),
         )
         self._last_good = ((params, opt_state), int(metadata.get("step", 0)))
         logger.info(f"loaded state from peers at global step {self.local_step}")

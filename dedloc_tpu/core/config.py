@@ -180,6 +180,11 @@ class TrainingArguments:
     max_local_steps: int = 0  # stop after N accumulation boundaries (0 = run forever)
     seq_length: int = 512
     per_device_batch_size: int = 4
+    # >1: this peer is a whole slice — a data-parallel mesh over N local
+    # devices; the per-micro-batch grad mean rides ICI psums and the slice
+    # acts as ONE collaboration member (SURVEY.md §2.6 TPU-native mapping)
+    mesh_devices: int = 1
+    mesh_device_offset: int = 0  # carve disjoint device ranges (tests)
     gradient_accumulation_steps: int = 2
     learning_rate: float = 0.00176
     warmup_steps: int = 5000

@@ -69,3 +69,24 @@ def synthetic_multicrop_batches(
                 views.append((means + noise).astype(np.float32))
             groups.append(np.concatenate(views, axis=0))
         yield groups
+
+
+def synthetic_labeled_images(
+    num_images: int,
+    size: int = 32,
+    num_classes: int = 8,
+    channels: int = 3,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Labeled single-crop fixture for linear-probe evaluation
+    (SyntheticImageDataset capability, sized test-small): each class has a
+    fixed mean color, so even a random frozen trunk yields linearly
+    separable pooled features. Returns (images [N,S,S,C] f32, labels [N])."""
+    rng = np.random.default_rng(seed)
+    class_means = rng.standard_normal((num_classes, 1, 1, channels)) * 1.5
+    labels = rng.integers(0, num_classes, num_images)
+    noise = rng.standard_normal(
+        (num_images, size, size, channels)
+    ).astype(np.float32) * 0.1
+    images = (class_means[labels] + noise).astype(np.float32)
+    return images, labels.astype(np.int32)

@@ -18,3 +18,9 @@ from dedloc_tpu.finetune.metrics import (  # noqa: F401
     extract_entities,
     span_f1,
 )
+from dedloc_tpu.finetune.linear_probe import (  # noqa: F401
+    LinearProbeArguments,
+    TopKMeter,
+    extract_features,
+    run_linear_probe,
+)

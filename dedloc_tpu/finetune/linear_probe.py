@@ -1,0 +1,169 @@
+"""Linear evaluation of a self-supervised trunk (SwAV quality anchor).
+
+Capability parity with the reference's evaluation protocol for SwAV
+checkpoints: extract features from the frozen ResNet trunk (vissl
+``extract_main``, swav/vissl/vissl/engines/extract.py) and train a linear
+classifier on them, scoring top-1/top-5 accuracy (vissl meters,
+swav/vissl/vissl/meters/; quality anchors in swav/vissl/MODEL_ZOO.md:191-196
+are ImageNet-1K linear top-1 numbers). The trunk weights come from a SwAV
+collaborative checkpoint via ``init_model_from_weights``-style surgery
+(vissl/utils/checkpoint.py:373 capability): only the ``trunk`` subtree is
+consumed; heads are discarded.
+
+TPU shape: feature extraction is one jitted eval forward over static-shape
+batches; the probe is a jitted softmax regression on cached features (the
+standard protocol trains the linear layer only, so there is no need to
+re-run the trunk per epoch).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class LinearProbeArguments:
+    learning_rate: float = 0.01
+    momentum: float = 0.9
+    weight_decay: float = 1e-6
+    num_epochs: int = 10
+    batch_size: int = 64
+    seed: int = 0
+
+
+class TopKMeter:
+    """Streaming top-k accuracy meter (vissl AccuracyListMeter capability)."""
+
+    def __init__(self, ks: Tuple[int, ...] = (1, 5)):
+        self.ks = ks
+        self.correct = {k: 0 for k in ks}
+        self.total = 0
+
+    def update(self, logits: np.ndarray, labels: np.ndarray) -> None:
+        order = np.argsort(-logits, axis=-1)
+        for k in self.ks:
+            topk = order[:, :k]
+            self.correct[k] += int((topk == labels[:, None]).any(axis=1).sum())
+        self.total += len(labels)
+
+    def value(self) -> Dict[str, float]:
+        return {
+            f"top_{k}": self.correct[k] / max(1, self.total) for k in self.ks
+        }
+
+
+def extract_features(
+    trunk_apply,
+    images: np.ndarray,  # [N, H, W, C]
+    batch_size: int = 64,
+) -> np.ndarray:
+    """Frozen-trunk feature extraction over static-shape batches
+    (extract_main capability). ``trunk_apply(images) -> [B, D]`` must be the
+    eval-mode trunk forward closed over frozen params/batch_stats."""
+    jitted = jax.jit(trunk_apply)
+    n = len(images)
+    feats = []
+    for i in range(0, n, batch_size):
+        idx = np.arange(i, min(i + batch_size, n))
+        real = len(idx)
+        if real < batch_size:  # pad to the compiled shape, slice off after
+            idx = np.concatenate([idx, np.zeros(batch_size - real, np.int64)])
+        out = np.asarray(jitted(jnp.asarray(images[idx])))
+        feats.append(out[:real])
+    return np.concatenate(feats, axis=0)
+
+
+def swav_trunk_apply(model, params, batch_stats):
+    """Build the frozen eval-mode trunk forward from SwAV train state —
+    checkpoint surgery: consume only the ``trunk`` subtree
+    (init_model_from_weights capability)."""
+    trunk_params = {"trunk": params["trunk"]}
+    trunk_stats = {"trunk": batch_stats["trunk"]}
+
+    def apply(images):
+        from dedloc_tpu.models.resnet import ResNet
+
+        return ResNet(model.cfg.trunk, name="trunk").apply(
+            {"params": trunk_params["trunk"],
+             "batch_stats": trunk_stats["trunk"]},
+            images,
+            False,  # eval mode: frozen BN statistics
+        )
+
+    return apply
+
+
+def run_linear_probe(
+    train_features: np.ndarray,  # [N, D]
+    train_labels: np.ndarray,  # [N]
+    eval_features: np.ndarray,
+    eval_labels: np.ndarray,
+    num_classes: int,
+    args: Optional[LinearProbeArguments] = None,
+) -> Dict[str, float]:
+    """Train the linear classifier on frozen features; return top-1/top-5.
+
+    SGD + momentum on softmax regression — the standard linear-eval protocol
+    behind the MODEL_ZOO numbers (trunk stays frozen; only W, b train).
+    """
+    args = args or LinearProbeArguments()
+    rng = np.random.default_rng(args.seed)
+    d = train_features.shape[1]
+
+    params = {
+        "w": jnp.zeros((d, num_classes), jnp.float32),
+        "b": jnp.zeros((num_classes,), jnp.float32),
+    }
+    tx = optax.chain(
+        optax.add_decayed_weights(args.weight_decay),
+        optax.sgd(args.learning_rate, momentum=args.momentum),
+    )
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, feats, labels):
+        def loss_fn(p):
+            logits = feats @ p["w"] + p["b"]
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.mean(
+                jnp.take_along_axis(logp, labels[:, None], axis=-1)
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    n = len(train_features)
+    bs = min(args.batch_size, n)
+    for epoch in range(args.num_epochs):
+        order = rng.permutation(n)
+        losses = []
+        for i in range(0, n - bs + 1, bs):
+            idx = order[i : i + bs]
+            params, opt_state, loss = train_step(
+                params, opt_state,
+                jnp.asarray(train_features[idx]),
+                jnp.asarray(train_labels[idx]),
+            )
+            losses.append(float(loss))
+        logger.info(
+            "linear probe epoch %d: loss %.4f", epoch,
+            float(np.mean(losses)) if losses else float("nan"),
+        )
+
+    meter = TopKMeter(ks=(1, min(5, num_classes)))
+    logits = np.asarray(
+        jnp.asarray(eval_features) @ params["w"] + params["b"]
+    )
+    meter.update(logits, eval_labels)
+    result = meter.value()
+    logger.info("linear probe eval: %s", result)
+    return result

@@ -23,8 +23,19 @@ def make_mesh(
     n_devices: Optional[int] = None,
     axis_names: Sequence[str] = ("data",),
     shape: Optional[Sequence[int]] = None,
+    device_offset: int = 0,
 ) -> Mesh:
-    devices = jax.devices()[: n_devices or len(jax.devices())]
+    """``device_offset`` lets several in-process "slices" carve disjoint
+    device ranges out of one virtual mesh (multi-slice tests without
+    multi-host hardware)."""
+    all_devices = jax.devices()
+    n = n_devices or len(all_devices) - device_offset
+    if device_offset + n > len(all_devices):
+        raise ValueError(
+            f"device_offset {device_offset} + n_devices {n} exceeds the "
+            f"{len(all_devices)} available devices"
+        )
+    devices = all_devices[device_offset : device_offset + n]
     if shape is None:
         shape = [len(devices)] + [1] * (len(axis_names) - 1)
     dev_array = np.array(devices).reshape(shape)

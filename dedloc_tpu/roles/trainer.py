@@ -13,6 +13,8 @@ accumulation boundary, not per micro-batch (SURVEY.md §7 hard-part b).
 """
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -47,8 +49,27 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
     dht, public_key = build_dht(args)
     logger.info(f"trainer DHT listening on {dht.port}")
 
+    # slice-as-one-peer: with mesh_devices > 1 this process drives a
+    # data-parallel mesh; the micro-batch grad mean lowers to ICI psums and
+    # the collaboration sees the whole slice as a single member
+    mesh = None
+    if args.training.mesh_devices > 1:
+        from dedloc_tpu.parallel.mesh import make_mesh, put_batch
+
+        mesh = make_mesh(
+            args.training.mesh_devices,
+            device_offset=args.training.mesh_device_offset,
+        )
+        logger.info(f"slice mesh: {mesh.shape}")
+
     rng = jax.random.PRNGKey(args.training.seed)
     seq = min(args.training.seq_length, cfg.max_position_embeddings)
+    slice_batch = args.training.per_device_batch_size * max(
+        1, args.training.mesh_devices
+    )
+    # init with the PER-DEVICE batch: param shapes don't depend on batch
+    # size, and a full slice batch would run this forward unsharded on one
+    # device — 8x the training-time activation memory on a real slice
     init_ids = jnp.zeros((args.training.per_device_batch_size, seq), jnp.int32)
     params = model.init(rng, init_ids)["params"]
     state = jax.jit(lambda p: TrainState.create(p, tx))(params)
@@ -72,8 +93,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         prefix=args.dht.experiment_prefix,
         target_batch_size=args.optimizer.target_batch_size,
         batch_size_per_step=(
-            args.training.per_device_batch_size
-            * args.training.gradient_accumulation_steps
+            slice_batch * args.training.gradient_accumulation_steps
         ),
         bandwidth=args.averager.bandwidth,
         compression=args.averager.compression,
@@ -89,17 +109,25 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         expected_drift_rate=args.averager.expected_drift_rate,
         performance_ema_alpha=args.averager.performance_ema_alpha,
         client_mode=args.dht.client_mode,
+        mesh=mesh,
         verbose=True,
     )
     # catch up with the collaboration before training (:124-128)
     state = opt.load_state_from_peers(state)
+    if mesh is not None:
+        # commit state onto the mesh once — otherwise accumulate's
+        # replicated in_shardings would re-broadcast the full params from
+        # the default device on every micro-batch until the first global step
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        state = jax.device_put(state, NamedSharding(mesh, P()))
 
     loss_fn = build_loss_fn(model)
-    accumulate = make_accumulate_step(loss_fn)
+    accumulate = make_accumulate_step(loss_fn, mesh=mesh)
     grad_acc = zeros_like_grads(state.params)
     n_acc = jnp.zeros([], jnp.int32)
 
-    batches = _make_batches(args, cfg, public_key)
+    batches = _make_batches(args, cfg, public_key, slice_batch)
     data_rng = jax.random.PRNGKey(peer_shuffle_seed(public_key))
 
     loss_sum, mini_steps = 0.0, 0
@@ -109,6 +137,8 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
             # one accumulation boundary = gradient_accumulation_steps micro-batches
             for _ in range(args.training.gradient_accumulation_steps):
                 batch = drop_collator_keys(next(batches))
+                if mesh is not None:
+                    batch = put_batch(batch, mesh)
                 data_rng, sub = jax.random.split(data_rng)
                 grad_acc, n_acc, metrics = accumulate(
                     state.params, grad_acc, n_acc, batch, sub
@@ -117,8 +147,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
                 mini_steps += 1
 
             samples = (
-                args.training.per_device_batch_size
-                * args.training.gradient_accumulation_steps
+                slice_batch * args.training.gradient_accumulation_steps
             )
             state, grad_acc, n_acc, stepped = opt.step(
                 state, grad_acc, n_acc, samples
@@ -182,14 +211,18 @@ def _named_to_tree_pair(named, template):
     return _named_to_tree(named, template)
 
 
-def _make_batches(args: CollaborationArguments, cfg, public_key: bytes):
+def _make_batches(
+    args: CollaborationArguments, cfg, public_key: bytes,
+    slice_batch: Optional[int] = None,
+):
     """Synthetic fixture by default; a tokenized-on-disk dataset when
     ``dataset_path`` is set (tokenize_wikitext103 output layout)."""
     seed = peer_shuffle_seed(public_key)  # per-peer independent shuffling
+    batch_size = slice_batch or args.training.per_device_batch_size
     if not args.training.dataset_path:
         return synthetic_mlm_batches(
             cfg,
-            args.training.per_device_batch_size,
+            batch_size,
             args.training.seq_length,
             seed,
         )
@@ -198,7 +231,7 @@ def _make_batches(args: CollaborationArguments, cfg, public_key: bytes):
     return tokenized_dataset_batches(
         args.training.dataset_path,
         cfg,
-        args.training.per_device_batch_size,
+        batch_size,
         args.training.seq_length,
         seed,
     )

@@ -111,3 +111,12 @@ def test_parse_config_respects_parent_default_factory_overrides():
         SwAVCollaborationArguments, ["--optimizer.target_batch_size", "64"]
     )
     assert args.optimizer.target_batch_size == 64
+
+
+def test_make_mesh_rejects_out_of_range_offset():
+    import pytest as _pytest
+
+    from dedloc_tpu.parallel.mesh import make_mesh
+
+    with _pytest.raises(ValueError, match="exceeds"):
+        make_mesh(4, device_offset=8)

@@ -1,0 +1,77 @@
+"""SwAV linear-probe evaluation (vissl extract + linear benchmark + meters
+capability): frozen-trunk feature extraction, top-k meters, probe training."""
+import numpy as np
+import pytest
+
+from dedloc_tpu.data.multicrop import synthetic_labeled_images
+from dedloc_tpu.finetune.linear_probe import (
+    LinearProbeArguments,
+    TopKMeter,
+    extract_features,
+    run_linear_probe,
+    swav_trunk_apply,
+)
+
+
+def test_topk_meter():
+    logits = np.array([
+        [0.1, 0.9, 0.0, 0.0],   # top1 = 1 ✓ (label 1)
+        [0.8, 0.1, 0.05, 0.05], # top1 = 0 ✗ (label 2), top2 miss, top3 hit
+        [0.0, 0.0, 0.0, 1.0],   # top1 = 3 ✓ (label 3)
+    ])
+    labels = np.array([1, 2, 3])
+    meter = TopKMeter(ks=(1, 3))
+    meter.update(logits, labels)
+    v = meter.value()
+    assert v["top_1"] == pytest.approx(2 / 3)
+    assert v["top_3"] == pytest.approx(3 / 3)
+    # streaming: second update accumulates
+    meter.update(logits, labels)
+    assert meter.total == 6
+
+
+def test_probe_on_separable_features():
+    rng = np.random.default_rng(0)
+    # 4 classes, features = class one-hot + noise: probe must nail it
+    n, d, classes = 256, 16, 4
+    labels = rng.integers(0, classes, n).astype(np.int32)
+    feats = rng.standard_normal((n, d)).astype(np.float32) * 0.05
+    feats[np.arange(n), labels] += 1.0
+    result = run_linear_probe(
+        feats[:192], labels[:192], feats[192:], labels[192:],
+        num_classes=classes,
+        args=LinearProbeArguments(num_epochs=20, batch_size=32,
+                                  learning_rate=0.5),
+    )
+    assert result["top_1"] > 0.9
+
+
+def test_swav_trunk_extract_and_probe():
+    """End-to-end: random frozen SwAV trunk -> features -> linear probe on a
+    class-separable synthetic set beats chance by a wide margin."""
+    import jax
+    from dedloc_tpu.models.swav import SwAVConfig, SwAVModel
+
+    cfg = SwAVConfig.tiny()
+    model = SwAVModel(cfg)
+    size = 16
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        [np.zeros((2, size, size, 3), np.float32)],
+        True,
+    )
+    apply_fn = swav_trunk_apply(
+        model, variables["params"], variables["batch_stats"]
+    )
+    images, labels = synthetic_labeled_images(
+        160, size=size, num_classes=4, seed=1
+    )
+    feats = extract_features(apply_fn, images, batch_size=32)
+    assert feats.shape[0] == 160 and feats.ndim == 2
+    result = run_linear_probe(
+        feats[:128], labels[:128], feats[128:], labels[128:],
+        num_classes=4,
+        args=LinearProbeArguments(num_epochs=15, batch_size=32,
+                                  learning_rate=0.3),
+    )
+    assert result["top_1"] > 0.5  # 4-way chance = 0.25

@@ -153,3 +153,53 @@ def test_two_trainer_roles_collaborate(tmp_path):
         assert max(int(s.step) for s in results.values()) >= 1
     finally:
         root_dht.shutdown()
+
+
+def test_two_slice_peers_hybrid_ici_dcn(tmp_path):
+    """The TPU-native two-level scheme end-to-end (SURVEY.md §1 swav seam,
+    §2.6 mapping): each peer is a SLICE — a 4-device data-parallel mesh
+    carved from the virtual 8-CPU pool — whose micro-batch grad mean rides
+    XLA collectives (the ICI path), while gradients average BETWEEN slices
+    through the DHT/TCP averager (the DCN path)."""
+    from dedloc_tpu.roles.common import build_dht
+
+    root_args = _args(tmp_path)
+    root_dht, _ = build_dht(root_args)
+    try:
+        addr = root_dht.get_visible_address()
+        results, errors = {}, []
+
+        def slice_peer(idx):
+            try:
+                args = _args(
+                    tmp_path,
+                    [
+                        "--dht.initial_peers", addr,
+                        "--optimizer.target_batch_size", "32",
+                        "--training.max_local_steps", "10",
+                        "--training.save_steps", "0",
+                        "--training.mesh_devices", "4",
+                        "--training.mesh_device_offset", str(idx * 4),
+                        "--training.output_dir",
+                        str(tmp_path / f"slice{idx}"),
+                        "--training.seed", str(idx),
+                    ],
+                )
+                results[idx] = run_trainer(args)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=slice_peer, args=(i,)) for i in (0, 1)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not errors, errors
+        assert len(results) == 2
+        # each boundary contributes 2 (per-dev) x 4 (mesh) x 2 (accum) = 16
+        # samples; two slices reach target 32 together => steps advance
+        assert max(int(s.step) for s in results.values()) >= 1
+    finally:
+        root_dht.shutdown()
