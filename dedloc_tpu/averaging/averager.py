@@ -55,6 +55,8 @@ class DecentralizedAverager:
         listen_host: str = "0.0.0.0",
         listen_port: int = 0,
         advertised_host: Optional[str] = None,
+        authorizer=None,  # TokenAuthorizerBase for gated runs (joiner side)
+        authority_public_key: Optional[bytes] = None,  # leader-side gate
     ):
         self.dht = dht
         self.prefix = prefix
@@ -106,6 +108,8 @@ class DecentralizedAverager:
                     bandwidth,
                     target_group_size=target_group_size,
                     averaging_expiration=averaging_expiration,
+                    authorizer=authorizer,
+                    authority_public_key=authority_public_key,
                 )
 
             return setup()

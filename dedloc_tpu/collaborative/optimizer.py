@@ -99,6 +99,8 @@ class CollaborativeOptimizer:
         listen_host: str = "0.0.0.0",
         advertised_host: Optional[str] = None,
         post_apply: Optional[Callable[[TrainState], TrainState]] = None,
+        authorizer=None,  # token authorizer for gated public runs
+        authority_public_key: Optional[bytes] = None,
     ):
         assert not (client_mode and auxiliary), "an auxiliary peer must listen"
         self.tx = tx
@@ -124,6 +126,8 @@ class CollaborativeOptimizer:
             target_group_size=target_group_size,
             listen_host=listen_host,
             advertised_host=advertised_host,
+            authorizer=authorizer,
+            authority_public_key=authority_public_key,
         )
         self.tracker = ProgressTracker(
             dht,

@@ -218,17 +218,27 @@ class AllowlistAuthorizer(TokenAuthorizerBase):
 # ------------------------------------------------------- request envelopes
 
 
-def _envelope_signing_bytes(payload: bytes, nonce: bytes, timestamp: float) -> bytes:
-    return payload + b"|" + nonce + b"|" + repr(float(timestamp)).encode()
+def _envelope_signing_bytes(
+    payload: bytes, nonce: bytes, timestamp: float, context: bytes = b""
+) -> bytes:
+    return (
+        context + b"|" + payload + b"|" + nonce + b"|"
+        + repr(float(timestamp)).encode()
+    )
 
 
 def wrap_request(
-    token: AccessToken, payload: bytes, sender_key: RSAPrivateKey
+    token: AccessToken,
+    payload: bytes,
+    sender_key: RSAPrivateKey,
+    context: bytes = b"",
 ) -> Dict:
     """Signed request envelope: the token proves admission (authority
     signature); the sender signature covers payload + a fresh nonce + a
-    timestamp, so a captured envelope cannot be replayed (hivemind's
-    AuthRPCWrapper includes per-request nonces for the same reason)."""
+    timestamp + the caller-chosen ``context`` (e.g. round id + recipient
+    identity), so a captured envelope can be replayed neither later NOR at a
+    different recipient/round (hivemind's AuthRPCWrapper includes
+    per-request nonces for the same reason)."""
     nonce = os.urandom(16)
     timestamp = get_dht_time()
     return {
@@ -237,7 +247,7 @@ def wrap_request(
         "nonce": nonce,
         "timestamp": timestamp,
         "payload_signature": sender_key.sign(
-            _envelope_signing_bytes(payload, nonce, timestamp)
+            _envelope_signing_bytes(payload, nonce, timestamp, context)
         ),
     }
 
@@ -266,11 +276,13 @@ def unwrap_request(
     now: Optional[float] = None,
     replay_guard: Optional[ReplayGuard] = None,
     max_age: float = 60.0,
+    context: bytes = b"",
 ) -> bytes:
     """Validate an envelope and return its payload, or raise
     AuthorizationError. Checks: token signature (authority), token expiry,
-    sender signature over payload+nonce+timestamp, freshness (``max_age``),
-    and — when a ``replay_guard`` is supplied — nonce uniqueness."""
+    sender signature over context+payload+nonce+timestamp (``context`` must
+    match what the sender bound), freshness (``max_age``), and — when a
+    ``replay_guard`` is supplied — nonce uniqueness."""
     token = AccessToken.from_wire(envelope["token"])
     if not verify_signature(
         authority_public_key, token.signing_bytes(), token.signature
@@ -286,7 +298,7 @@ def unwrap_request(
         raise AuthorizationError("request envelope is stale")
     if not verify_signature(
         token.peer_public_key,
-        _envelope_signing_bytes(payload, nonce, timestamp),
+        _envelope_signing_bytes(payload, nonce, timestamp, context),
         bytes(envelope["payload_signature"]),
     ):
         raise AuthorizationError("payload signature invalid")

@@ -85,15 +85,14 @@ def swav_trunk_apply(model, params, batch_stats):
     """Build the frozen eval-mode trunk forward from SwAV train state —
     checkpoint surgery: consume only the ``trunk`` subtree
     (init_model_from_weights capability)."""
-    trunk_params = {"trunk": params["trunk"]}
-    trunk_stats = {"trunk": batch_stats["trunk"]}
+    trunk_params = params["trunk"]
+    trunk_stats = batch_stats["trunk"]
 
     def apply(images):
         from dedloc_tpu.models.resnet import ResNet
 
         return ResNet(model.cfg.trunk, name="trunk").apply(
-            {"params": trunk_params["trunk"],
-             "batch_stats": trunk_stats["trunk"]},
+            {"params": trunk_params, "batch_stats": trunk_stats},
             images,
             False,  # eval mode: frozen BN statistics
         )

@@ -29,11 +29,11 @@ def make_mesh(
     device ranges out of one virtual mesh (multi-slice tests without
     multi-host hardware)."""
     all_devices = jax.devices()
-    n = n_devices or len(all_devices) - device_offset
-    if device_offset + n > len(all_devices):
+    n = len(all_devices) - device_offset if n_devices is None else n_devices
+    if n <= 0 or device_offset < 0 or device_offset + n > len(all_devices):
         raise ValueError(
             f"device_offset {device_offset} + n_devices {n} exceeds the "
-            f"{len(all_devices)} available devices"
+            f"{len(all_devices)} available devices (or is non-positive)"
         )
     devices = all_devices[device_offset : device_offset + n]
     if shape is None:

@@ -179,3 +179,20 @@ def test_non_ascii_credentials():
     assert token.username == "josé"
     with pytest.raises(AuthorizationError):
         asyncio.run(make_client(server, "josé", "wröng").get_token())
+
+
+def test_request_envelope_context_binding(server):
+    client = make_client(server)
+    token = asyncio.run(client.refresh_token_if_needed())
+    env = wrap_request(token, b"join-me", client.local_private_key,
+                       context=b"round1@leaderA")
+    # correct context accepted
+    assert unwrap_request(env, server.authority_public_key,
+                          context=b"round1@leaderA") == b"join-me"
+    # replayed at a different leader/round: signature no longer verifies
+    with pytest.raises(AuthorizationError, match="signature"):
+        unwrap_request(env, server.authority_public_key,
+                       context=b"round1@leaderB")
+    with pytest.raises(AuthorizationError, match="signature"):
+        unwrap_request(env, server.authority_public_key,
+                       context=b"round2@leaderA")

@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from dedloc_tpu.averaging.allreduce import AllreduceFailed, GroupAllReduce
-from dedloc_tpu.averaging.matchmaking import Matchmaking
+from dedloc_tpu.averaging.matchmaking import Matchmaking, MatchmakingFailed
 from dedloc_tpu.averaging.partition import (
     flatten_tree,
     partition_weighted,
@@ -407,3 +407,167 @@ def test_averager_state_sharing():
     finally:
         provider.shutdown(); joiner.shutdown()
         second.shutdown(); first.shutdown()
+
+
+# ------------------------------------------------------- gated matchmaking
+
+
+def test_gated_matchmaking_admits_tokened_rejects_untokened():
+    """sahajbert public-run capability: leaders admit only joiners whose
+    member record rides a valid signed token envelope; peers without a token
+    (or with a foreign authority's token) are turned away at the door."""
+    from dedloc_tpu.core.auth import AllowlistAuthServer, AllowlistAuthorizer
+
+    async def run():
+        auth_server = AllowlistAuthServer({"alice": "pw", "bob": "pw"})
+        rogue_authority = AllowlistAuthServer({"eve": "pw"})
+
+        first = await DHTNode.create(listen_host="127.0.0.1")
+        nodes = [first] + [
+            await DHTNode.create(listen_host="127.0.0.1",
+                                 initial_peers=[first.endpoint])
+            for _ in range(2)
+        ]
+        servers, clients, mms = [], [], []
+        authorizers = [
+            AllowlistAuthorizer("alice", "pw", auth_server.issue_token,
+                                auth_server.authority_public_key),
+            AllowlistAuthorizer("bob", "pw", auth_server.issue_token,
+                                auth_server.authority_public_key),
+            # eve's token comes from a DIFFERENT authority — must be refused
+            AllowlistAuthorizer("eve", "pw", rogue_authority.issue_token,
+                                rogue_authority.authority_public_key),
+        ]
+        try:
+            for node, authorizer in zip(nodes, authorizers):
+                client = RPCClient(request_timeout=10.0)
+                server = RPCServer("127.0.0.1", 0)
+                await server.start()
+                clients.append(client)
+                servers.append(server)
+                mms.append(
+                    Matchmaking(
+                        node, client, server, "gated",
+                        node.node_id.to_bytes(),
+                        ("127.0.0.1", server.port), bandwidth=1.0,
+                        averaging_expiration=1.0,
+                        authorizer=authorizer,
+                        authority_public_key=(
+                            auth_server.authority_public_key
+                        ),
+                    )
+                )
+
+            async def form(i):
+                await asyncio.sleep(0.05 * i)
+                try:
+                    return await mms[i].form_group("r1")
+                except MatchmakingFailed as e:
+                    return e
+
+            r0, r1, r2 = await asyncio.gather(form(0), form(1), form(2))
+            # alice + bob form a group together; eve is rejected everywhere
+            assert not isinstance(r0, Exception)
+            assert not isinstance(r1, Exception)
+            admitted = {m.peer_id for m in r0.members}
+            assert nodes[2].node_id.to_bytes() not in admitted
+            assert isinstance(r2, (MatchmakingFailed, Exception)) or (
+                len(r2.members) == 1  # eve could only self-lead a singleton
+            )
+        finally:
+            await _mm_teardown(nodes, servers, clients)
+
+    asyncio.run(run())
+
+
+def test_ungated_join_has_no_auth_overhead():
+    """Without an authority key, join requests carry the plain member record
+    (no tokens, no envelopes) — the controlled-experiment path."""
+    async def run():
+        nodes, mms, servers, clients = await _mm_swarm(2)
+        try:
+            g0, g1 = await asyncio.gather(
+                mms[0].form_group("r1"), mms[1].form_group("r1")
+            )
+            assert {m.peer_id for m in g0.members} == {
+                m.peer_id for m in g1.members
+            }
+        finally:
+            await _mm_teardown(nodes, servers, clients)
+
+    asyncio.run(run())
+
+
+def test_gated_mutual_auth_rejects_rogue_leader():
+    """An unadmitted peer cannot LEAD either: honest joiners refuse reply
+    envelopes that aren't signed by an authority-admitted leader."""
+    from dedloc_tpu.core.auth import AllowlistAuthServer, AllowlistAuthorizer
+
+    async def run():
+        auth_server = AllowlistAuthServer({"alice": "pw"})
+
+        first = await DHTNode.create(listen_host="127.0.0.1")
+        rogue_node = await DHTNode.create(
+            listen_host="127.0.0.1", initial_peers=[first.endpoint]
+        )
+        servers, clients = [], []
+
+        def make_mm(node, authorizer):
+            client = RPCClient(request_timeout=10.0)
+            clients.append(client)
+            return node, client, authorizer
+
+        # rogue: NO authorizer, tries to lead (its server is ungated so it
+        # happily assembles — but its reply carries no leader envelope)
+        rogue_client = RPCClient(request_timeout=10.0)
+        rogue_server = RPCServer("127.0.0.1", 0)
+        await rogue_server.start()
+        clients.append(rogue_client)
+        servers.append(rogue_server)
+        rogue = Matchmaking(
+            rogue_node, rogue_client, rogue_server, "gated2",
+            rogue_node.node_id.to_bytes(),
+            ("127.0.0.1", rogue_server.port), bandwidth=1.0,
+            averaging_expiration=1.0,
+        )
+
+        alice_client = RPCClient(request_timeout=10.0)
+        alice_server = RPCServer("127.0.0.1", 0)
+        await alice_server.start()
+        clients.append(alice_client)
+        servers.append(alice_server)
+        alice = Matchmaking(
+            first, alice_client, alice_server, "gated2",
+            first.node_id.to_bytes(),
+            ("127.0.0.1", alice_server.port), bandwidth=1.0,
+            averaging_expiration=1.0,
+            authorizer=AllowlistAuthorizer(
+                "alice", "pw", auth_server.issue_token,
+                auth_server.authority_public_key,
+            ),
+            authority_public_key=auth_server.authority_public_key,
+        )
+
+        try:
+            # rogue declares leadership first; alice sees it, tries to join,
+            # rejects the unsigned reply, and falls back to leading herself
+            rogue_task = asyncio.create_task(rogue.form_group("r1"))
+            await asyncio.sleep(0.2)
+            group = await alice.form_group("r1")
+            rogue_group = await rogue_task
+            assert first.node_id.to_bytes() in {
+                m.peer_id for m in group.members
+            }
+            # alice's gradients never land in the rogue group
+            assert first.node_id.to_bytes() not in {
+                m.peer_id for m in rogue_group.members
+            }
+        finally:
+            for c in clients:
+                await c.close()
+            for s in servers:
+                await s.stop()
+            await first.shutdown()
+            await rogue_node.shutdown()
+
+    asyncio.run(run())
