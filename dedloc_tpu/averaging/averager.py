@@ -90,7 +90,17 @@ class DecentralizedAverager:
                     self.server.register("state.get", self._rpc_state_get)
                     await self.server.start()
                     self.endpoint = (self._advertised_host, self.server.port)
-                self.peer_id = node.node_id.to_bytes()
+                if authorizer is not None:
+                    # gated runs bind peer identity to the token key so
+                    # leaders/joiners can verify who signed what (see
+                    # matchmaking identity binding)
+                    from dedloc_tpu.core.auth import peer_id_from_public_key
+
+                    self.peer_id = peer_id_from_public_key(
+                        authorizer.local_public_key
+                    )
+                else:
+                    self.peer_id = node.node_id.to_bytes()
                 self.allreduce = GroupAllReduce(
                     self.client,
                     self.server,

@@ -77,6 +77,16 @@ class AuthorizationError(Exception):
     """Raised when the authority rejects a peer or a token fails checks."""
 
 
+def peer_id_from_public_key(public_key: bytes) -> bytes:
+    """Canonical peer identity for gated runs: a digest of the token-bound
+    RSA public key. Binding peer ids to keys is what lets receivers check
+    that a signed envelope's token actually belongs to the peer identity
+    claimed in the payload (no impersonation of other members/leaders)."""
+    import hashlib
+
+    return hashlib.sha256(public_key).digest()[:20]
+
+
 class AllowlistAuthServer:
     """In-process authority: allowlist + credential check -> signed tokens.
 
@@ -277,12 +287,15 @@ def unwrap_request(
     replay_guard: Optional[ReplayGuard] = None,
     max_age: float = 60.0,
     context: bytes = b"",
-) -> bytes:
-    """Validate an envelope and return its payload, or raise
-    AuthorizationError. Checks: token signature (authority), token expiry,
-    sender signature over context+payload+nonce+timestamp (``context`` must
-    match what the sender bound), freshness (``max_age``), and — when a
-    ``replay_guard`` is supplied — nonce uniqueness."""
+    return_token: bool = False,
+):
+    """Validate an envelope and return its payload (or ``(payload, token)``
+    with ``return_token`` — callers use the token to bind the sender's key
+    to the identity claimed in the payload), or raise AuthorizationError.
+    Checks: token signature (authority), token expiry, sender signature over
+    context+payload+nonce+timestamp (``context`` must match what the sender
+    bound), freshness (``max_age``), and — when a ``replay_guard`` is
+    supplied — nonce uniqueness."""
     token = AccessToken.from_wire(envelope["token"])
     if not verify_signature(
         authority_public_key, token.signing_bytes(), token.signature
@@ -306,7 +319,7 @@ def unwrap_request(
         nonce, t_now
     ):
         raise AuthorizationError("replayed request envelope")
-    return payload
+    return (payload, token) if return_token else payload
 
 
 # ---------------------------------------------------------------- retries

@@ -177,6 +177,12 @@ class TrainingArguments:
 
     model_size: str = "large"  # tiny (CI fixture) | large
     dataset_path: str = ""  # tokenized dataset dir; empty = synthetic fixture
+    # streaming mode (sahajbert capability): one-document-per-line text
+    # files mixed by weight, tokenized on the fly (needs tokenizer_path)
+    streaming_files: List[str] = field(default_factory=list)
+    streaming_weights: List[float] = field(default_factory=list)
+    streaming_buffer_size: int = 10_000
+    tokenizer_path: str = ""  # trained tokenizer.json for streaming mode
     max_local_steps: int = 0  # stop after N accumulation boundaries (0 = run forever)
     seq_length: int = 512
     per_device_batch_size: int = 4

@@ -103,3 +103,74 @@ def batched(source: Iterable[Any], batch_size: int) -> Iterator[List[Any]]:
         if len(chunk) < batch_size:
             return
         yield chunk
+
+
+def split_sentences(text: str, delimiters: str = ".!?।") -> List[str]:
+    """Delimiter-based sentence splitting (the Bengali danda ``।`` included —
+    dataset_streaming.py:33 handles it via bnlp; this is the dependency-free
+    equivalent). Keeps the delimiter attached to its sentence."""
+    sentences: List[str] = []
+    current: List[str] = []
+    for ch in text:
+        current.append(ch)
+        if ch in delimiters:
+            s = "".join(current).strip()
+            if s:
+                sentences.append(s)
+            current = []
+    tail = "".join(current).strip()
+    if tail:
+        sentences.append(tail)
+    return sentences
+
+
+def text_file_source(path: str) -> Callable[[], Iterable[str]]:
+    """Restartable one-document-per-line reader for ``repeat_forever``."""
+
+    def factory() -> Iterator[str]:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield line
+
+    return factory
+
+
+def streaming_mlm_batches(
+    text_sources: Sequence[Callable[[], Iterable[str]]],
+    weights: Sequence[float],
+    tokenize_sentences: Callable[[str], List[List[int]]],
+    tokens,
+    batch_size: int,
+    max_seq_length: int,
+    seed: int,
+    buffer_size: int = 10_000,
+    max_predictions: int = 0,
+) -> Iterator[dict]:
+    """The full streaming pipeline (make_lazy_wikioscar_dataset capability,
+    dataset_streaming.py:116-139): weighted lazy mix of restartable document
+    sources -> per-peer-seeded shuffle buffer -> on-the-fly MLM+SOP instance
+    building -> fixed-shape masked batches. Infinite; never epoch-bounded."""
+    from dedloc_tpu.data.mlm import (
+        create_instances_from_document,
+        mask_tokens,
+        pad_and_batch,
+    )
+
+    rng = np.random.default_rng(seed)
+
+    def instance_stream() -> Iterator[dict]:
+        sources = [repeat_forever(f) for f in text_sources]
+        for doc in interleave_weighted(sources, weights, seed=seed):
+            sentences = tokenize_sentences(doc)
+            yield from create_instances_from_document(
+                sentences, max_seq_length, rng, tokens
+            )
+
+    shuffled = ShuffleBuffer(buffer_size, seed=seed)(instance_stream())
+    for group in batched(shuffled, batch_size):
+        batch = pad_and_batch(group, max_seq_length, tokens)
+        yield mask_tokens(
+            batch, rng, tokens, max_predictions=max_predictions
+        )

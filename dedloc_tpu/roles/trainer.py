@@ -219,6 +219,48 @@ def _make_batches(
     ``dataset_path`` is set (tokenize_wikitext103 output layout)."""
     seed = peer_shuffle_seed(public_key)  # per-peer independent shuffling
     batch_size = slice_batch or args.training.per_device_batch_size
+    if args.training.streaming_files:
+        # sahajbert-style streaming mode (dataset_streaming.py capability):
+        # weighted lazy mix + per-peer shuffle buffer + on-the-fly tokenize
+        from dedloc_tpu.data.mlm import SpecialTokens
+        from dedloc_tpu.data.streaming import (
+            split_sentences,
+            streaming_mlm_batches,
+            text_file_source,
+        )
+        from dedloc_tpu.data.tokenizer import load_fast_tokenizer
+
+        tok = load_fast_tokenizer(args.training.tokenizer_path)
+        if tok.vocab_size > cfg.vocab_size:
+            # fail fast: ids past the embedding table would be silently
+            # clamped by XLA's gather, corrupting training without an error
+            raise ValueError(
+                f"tokenizer vocab ({tok.vocab_size}) exceeds the model's "
+                f"vocab_size ({cfg.vocab_size}); retrain the tokenizer or "
+                "use a larger model vocab"
+            )
+        tokens = SpecialTokens(
+            cls_id=tok.cls_id, sep_id=tok.sep_id, pad_id=tok.pad_id,
+            mask_id=tok.mask_id, vocab_size=tok.vocab_size,
+        )
+        weights = args.training.streaming_weights or (
+            [1.0] * len(args.training.streaming_files)
+        )
+        seq = min(args.training.seq_length, cfg.max_position_embeddings)
+        return streaming_mlm_batches(
+            [text_file_source(p) for p in args.training.streaming_files],
+            weights,
+            lambda doc: [
+                tok.encode_ids(s, add_special_tokens=False)
+                for s in split_sentences(doc)
+            ],
+            tokens,
+            batch_size,
+            seq,
+            seed,
+            buffer_size=args.training.streaming_buffer_size,
+            max_predictions=int(seq * 0.15) + 4,
+        )
     if not args.training.dataset_path:
         return synthetic_mlm_batches(
             cfg,

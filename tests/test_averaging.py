@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from dedloc_tpu.averaging.allreduce import AllreduceFailed, GroupAllReduce
-from dedloc_tpu.averaging.matchmaking import Matchmaking, MatchmakingFailed
+from dedloc_tpu.averaging.matchmaking import Matchmaking, MatchmakingFailed, Member
 from dedloc_tpu.averaging.partition import (
     flatten_tree,
     partition_weighted,
@@ -445,10 +445,12 @@ def test_gated_matchmaking_admits_tokened_rejects_untokened():
                 await server.start()
                 clients.append(client)
                 servers.append(server)
+                from dedloc_tpu.core.auth import peer_id_from_public_key
+
                 mms.append(
                     Matchmaking(
                         node, client, server, "gated",
-                        node.node_id.to_bytes(),
+                        peer_id_from_public_key(authorizer.local_public_key),
                         ("127.0.0.1", server.port), bandwidth=1.0,
                         averaging_expiration=1.0,
                         authorizer=authorizer,
@@ -469,8 +471,11 @@ def test_gated_matchmaking_admits_tokened_rejects_untokened():
             # alice + bob form a group together; eve is rejected everywhere
             assert not isinstance(r0, Exception)
             assert not isinstance(r1, Exception)
+            from dedloc_tpu.core.auth import peer_id_from_public_key
+
             admitted = {m.peer_id for m in r0.members}
-            assert nodes[2].node_id.to_bytes() not in admitted
+            eve_id = peer_id_from_public_key(authorizers[2].local_public_key)
+            assert eve_id not in admitted
             assert isinstance(r2, (MatchmakingFailed, Exception)) or (
                 len(r2.members) == 1  # eve could only self-lead a singleton
             )
@@ -536,15 +541,18 @@ def test_gated_mutual_auth_rejects_rogue_leader():
         await alice_server.start()
         clients.append(alice_client)
         servers.append(alice_server)
+        from dedloc_tpu.core.auth import peer_id_from_public_key
+
+        alice_auth = AllowlistAuthorizer(
+            "alice", "pw", auth_server.issue_token,
+            auth_server.authority_public_key,
+        )
         alice = Matchmaking(
             first, alice_client, alice_server, "gated2",
-            first.node_id.to_bytes(),
+            peer_id_from_public_key(alice_auth.local_public_key),
             ("127.0.0.1", alice_server.port), bandwidth=1.0,
             averaging_expiration=1.0,
-            authorizer=AllowlistAuthorizer(
-                "alice", "pw", auth_server.issue_token,
-                auth_server.authority_public_key,
-            ),
+            authorizer=alice_auth,
             authority_public_key=auth_server.authority_public_key,
         )
 
@@ -555,11 +563,10 @@ def test_gated_mutual_auth_rejects_rogue_leader():
             await asyncio.sleep(0.2)
             group = await alice.form_group("r1")
             rogue_group = await rogue_task
-            assert first.node_id.to_bytes() in {
-                m.peer_id for m in group.members
-            }
+            alice_id = peer_id_from_public_key(alice_auth.local_public_key)
+            assert alice_id in {m.peer_id for m in group.members}
             # alice's gradients never land in the rogue group
-            assert first.node_id.to_bytes() not in {
+            assert alice_id not in {
                 m.peer_id for m in rogue_group.members
             }
         finally:
@@ -569,5 +576,85 @@ def test_gated_mutual_auth_rejects_rogue_leader():
                 await s.stop()
             await first.shutdown()
             await rogue_node.shutdown()
+
+    asyncio.run(run())
+
+
+def test_gated_leader_requires_authorizer_at_construction():
+    """Config mismatch (gate key, no authorizer) on a listening peer fails
+    at startup, not as a distributed stall mid-assembly."""
+    from dedloc_tpu.core.auth import AllowlistAuthServer
+
+    async def run():
+        auth_server = AllowlistAuthServer({"a": "pw"})
+        node = await DHTNode.create(listen_host="127.0.0.1")
+        client = RPCClient(request_timeout=5.0)
+        server = RPCServer("127.0.0.1", 0)
+        await server.start()
+        try:
+            with pytest.raises(ValueError, match="authorizer"):
+                Matchmaking(
+                    node, client, server, "x", b"id", ("127.0.0.1", 1),
+                    bandwidth=1.0,
+                    authority_public_key=auth_server.authority_public_key,
+                )
+        finally:
+            await client.close()
+            await server.stop()
+            await node.shutdown()
+
+    asyncio.run(run())
+
+
+def test_gated_join_rejects_impersonated_member_id():
+    """An ADMITTED peer cannot claim another identity: the member record's
+    peer_id must derive from the signing token's key."""
+    from dedloc_tpu.core.auth import (
+        AllowlistAuthServer,
+        AllowlistAuthorizer,
+        peer_id_from_public_key,
+        wrap_request,
+    )
+    from dedloc_tpu.core.serialization import pack_obj
+
+    async def run():
+        auth_server = AllowlistAuthServer({"alice": "pw", "mallory": "pw"})
+        alice_auth = AllowlistAuthorizer(
+            "alice", "pw", auth_server.issue_token,
+            auth_server.authority_public_key,
+        )
+        mallory_auth = AllowlistAuthorizer(
+            "mallory", "pw", auth_server.issue_token,
+            auth_server.authority_public_key,
+        )
+        node = await DHTNode.create(listen_host="127.0.0.1")
+        client = RPCClient(request_timeout=5.0)
+        server = RPCServer("127.0.0.1", 0)
+        await server.start()
+        leader_id = peer_id_from_public_key(alice_auth.local_public_key)
+        mm = Matchmaking(
+            node, client, server, "imp", leader_id,
+            ("127.0.0.1", server.port), bandwidth=1.0,
+            averaging_expiration=0.5,
+            authorizer=alice_auth,
+            authority_public_key=auth_server.authority_public_key,
+        )
+        try:
+            # mallory holds a VALID token but claims the leader's peer_id
+            token = await mallory_auth.refresh_token_if_needed()
+            forged = Member(leader_id, ("127.0.0.1", 1), 999.0)
+            envelope = wrap_request(
+                token, pack_obj(forged.pack()),
+                mallory_auth.local_private_key,
+                context=mm._context("r1", leader_id),
+            )
+            with pytest.raises(MatchmakingFailed, match="token key"):
+                await mm._rpc_join(
+                    ("127.0.0.1", 0), {"round_id": "r1", "auth": envelope}
+                )
+        finally:
+            await client.close()
+            await server.stop()
+            await node.shutdown()
 
     asyncio.run(run())

@@ -150,3 +150,59 @@ def test_disk_shards_roundtrip(rng, tmp_path):
     n_epoch = total // 4
     for _ in range(n_epoch + 2):
         next(stream)
+
+
+def test_split_sentences_handles_danda():
+    from dedloc_tpu.data.streaming import split_sentences
+
+    out = split_sentences("আমি ভাত খাই। তুমি কি খাও? Yes.")
+    assert out == ["আমি ভাত খাই।", "তুমি কি খাও?", "Yes."]
+    assert split_sentences("no delimiter at all") == ["no delimiter at all"]
+
+
+def test_streaming_mlm_batches_end_to_end(tmp_path):
+    from dedloc_tpu.data.mlm import SpecialTokens
+    from dedloc_tpu.data.streaming import (
+        split_sentences,
+        streaming_mlm_batches,
+        text_file_source,
+    )
+
+    rng = np.random.default_rng(0)
+    f1, f2 = tmp_path / "wiki.txt", tmp_path / "oscar.txt"
+    f1.write_text(
+        "\n".join(
+            " ".join(f"w{rng.integers(100)}" for _ in range(30)) + "."
+            for _ in range(20)
+        )
+    )
+    f2.write_text(
+        "\n".join(
+            " ".join(f"o{rng.integers(100)}" for _ in range(30)) + "."
+            for _ in range(20)
+        )
+    )
+    tokens = SpecialTokens(vocab_size=512)
+
+    def fake_tokenize(sent):
+        return [(hash(w) % 400) + tokens.num_reserved for w in sent.split()]
+
+    batches = streaming_mlm_batches(
+        [text_file_source(str(f1)), text_file_source(str(f2))],
+        [0.3, 0.7],
+        lambda doc: [fake_tokenize(s) for s in split_sentences(doc)],
+        tokens,
+        batch_size=4,
+        max_seq_length=64,
+        seed=7,
+        buffer_size=16,
+        max_predictions=12,
+    )
+    batch = next(batches)
+    assert batch["input_ids"].shape == (4, 64)
+    assert batch["mlm_positions"].shape == (4, 12)
+    assert (batch["sop_labels"] >= 0).all()
+    # infinite: keeps producing past both files' natural end
+    for _ in range(30):
+        batch = next(batches)
+    assert batch["input_ids"].shape == (4, 64)
