@@ -233,6 +233,8 @@ class SwAVTrainingArguments:
     total_steps: int = 100_000
     queue_length: int = 0  # per-peer embedding queue (0 = off)
     queue_start_step: int = 0  # global step gating use_queue (yaml :95)
+    mesh_devices: int = 1  # >1: this peer is a whole slice (see trainer)
+    mesh_device_offset: int = 0
     seed: int = 0
     output_dir: str = "outputs_swav"
     save_steps: int = 0

@@ -305,11 +305,23 @@ class SwAVTrainState(struct.PyTreeNode):
     queue: Optional[SwAVQueue] = None
 
 
-def make_swav_train_step(model: SwAVModel, cfg: SwAVConfig, tx):
+def _swav_shardings(mesh):
+    """(replicated, crops-sharded) NamedShardings for the step builders.
+    Crops shard over the data axis; with the GLOBAL batch inside jit, the
+    sinkhorn row/column sums lower to ICI psums automatically — the
+    TPU-native inversion of the reference's all_reduce-in-loop."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P()), NamedSharding(mesh, P("data"))
+
+
+def make_swav_train_step(model: SwAVModel, cfg: SwAVConfig, tx, mesh=None,
+                         num_crop_groups: int = 2):
     """Fused jitted step: forward (BN stats mutable), swav loss (+queue),
     prototype freeze mask, optimizer update, prototype re-normalization,
     queue shift-in. ``use_queue`` is static (two compiled variants, like the
-    reference's queue.start_iter gate at swav_loss.py:84-91)."""
+    reference's queue.start_iter gate at swav_loss.py:84-91). With ``mesh``,
+    crops shard over the data axis and state replicates."""
 
     def train_step(state: SwAVTrainState, crops, use_queue: bool):
         queue_scores = (
@@ -351,10 +363,18 @@ def make_swav_train_step(model: SwAVModel, cfg: SwAVConfig, tx):
             {"loss": loss},
         )
 
-    return jax.jit(train_step, static_argnums=(2,), donate_argnums=(0,))
+    kwargs = dict(static_argnums=(2,), donate_argnums=(0,))
+    if mesh is not None:
+        repl, data = _swav_shardings(mesh)
+        kwargs.update(
+            in_shardings=(repl, [data] * num_crop_groups),
+            out_shardings=(repl, repl),
+        )
+    return jax.jit(train_step, **kwargs)
 
 
-def make_swav_accumulate_step(model: SwAVModel, cfg: SwAVConfig):
+def make_swav_accumulate_step(model: SwAVModel, cfg: SwAVConfig, mesh=None,
+                              num_crop_groups: int = 2):
     """Collaborative variant: per micro-batch grad accumulation (the shape
     CollaborativeOptimizer.step consumes, like make_accumulate_step for
     ALBERT). BN statistics and the queue are LOCAL per-peer state (exactly as
@@ -398,7 +418,17 @@ def make_swav_accumulate_step(model: SwAVModel, cfg: SwAVConfig):
         new_queue = queue.update(emb, cfg) if queue is not None else None
         return grad_acc, n_acc + 1, new_bn, new_queue, {"loss": loss}
 
-    return jax.jit(step, static_argnums=(7,), donate_argnums=(3, 4))
+    kwargs = dict(static_argnums=(7,), donate_argnums=(3, 4))
+    if mesh is not None:
+        # num_crop_groups must equal len(spec.sizes) of the feeding
+        # MultiCropSpec — the sharding pytree must mirror the crops list
+        repl, data = _swav_shardings(mesh)
+        kwargs.update(
+            in_shardings=(repl, repl, repl, repl, repl,
+                          [data] * num_crop_groups, repl),
+            out_shardings=(repl, repl, repl, repl, repl),
+        )
+    return jax.jit(step, **kwargs)
 
 
 def make_prototype_post_apply():

@@ -78,6 +78,18 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
     dht, _public_key = build_dht(args)
     logger.info(f"swav peer DHT listening on {dht.port}")
 
+    # slice-as-one-peer (same mapping as the ALBERT trainer): crops shard
+    # over the data axis, so the sinkhorn sums inside the jitted loss ride
+    # ICI psums — the reference's NCCL all_reduce world, compiler-inserted
+    mesh = None
+    slice_factor = max(1, t.mesh_devices)
+    if t.mesh_devices > 1:
+        from dedloc_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(t.mesh_devices, device_offset=t.mesh_device_offset)
+        logger.info(f"swav slice mesh: {mesh.shape}")
+    slice_batch = t.per_device_batch_size * slice_factor
+
     rng = jax.random.PRNGKey(t.seed)
     init_crops = [
         jnp.zeros((count * t.per_device_batch_size, size, size, spec.channels))
@@ -98,7 +110,7 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
         prefix=args.dht.experiment_prefix,
         target_batch_size=args.optimizer.target_batch_size,
         batch_size_per_step=(
-            t.per_device_batch_size * t.gradient_accumulation_steps
+            slice_batch * t.gradient_accumulation_steps
         ),
         bandwidth=args.averager.bandwidth,
         compression=args.averager.compression,
@@ -108,18 +120,21 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
         metadata_expiration=args.averager.metadata_expiration,
         statistics_expiration=args.optimizer.statistics_expiration,
         client_mode=args.dht.client_mode,
+        mesh=mesh,
         post_apply=make_prototype_post_apply(),
         verbose=True,
     )
     state = opt.load_state_from_peers(state)
 
-    accumulate = make_swav_accumulate_step(model, cfg)
+    accumulate = make_swav_accumulate_step(
+        model, cfg, mesh=mesh, num_crop_groups=len(spec.sizes)
+    )
     grad_acc = zeros_like_grads(state.params)
     n_acc = jnp.zeros([], jnp.int32)
     batches = synthetic_multicrop_batches(
-        spec, t.per_device_batch_size, seed=t.seed
+        spec, slice_batch, seed=t.seed
     )
-    samples = t.per_device_batch_size * t.gradient_accumulation_steps
+    samples = slice_batch * t.gradient_accumulation_steps
 
     # mutable local (non-collaborative) state, closed over by the step fn
     local = {"batch_stats": batch_stats, "queue": queue,
@@ -139,7 +154,7 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
                     local["queue"],
                     local["grad_acc"],
                     local["n_acc"],
-                    [jnp.asarray(c) for c in crops],
+                    _put_crops(crops),
                     jnp.asarray(opt.local_step, jnp.int32),
                     use_queue,
                 )
@@ -148,6 +163,14 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
             state, local["grad_acc"], local["n_acc"], samples
         )
         return state, {"loss": loss, "global_step": opt.local_step}
+
+    def _put_crops(crops):
+        if mesh is None:
+            return [jnp.asarray(c) for c in crops]
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        data = NamedSharding(mesh, P("data"))
+        return [jax.device_put(jnp.asarray(c), data) for c in crops]
 
     def grouped(it: Iterator, k: int) -> Iterator[list]:
         while True:
