@@ -50,9 +50,18 @@ def _build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    src = os.path.join(_SRC_DIR, "wirecodec.cpp")
+    try:
+        return os.path.getmtime(src) > os.path.getmtime(_SO_PATH)
+    except OSError:
+        return False
+
+
 def _load() -> Optional[ctypes.CDLL]:
-    if not os.path.exists(_SO_PATH) and not _build():
-        return None
+    if (not os.path.exists(_SO_PATH) or _stale()) and not _build():
+        if not os.path.exists(_SO_PATH):
+            return None
     try:
         lib = ctypes.CDLL(_SO_PATH)
     except OSError:

@@ -728,3 +728,66 @@ def test_gated_joiner_rejects_forged_member_in_reply():
             await node.shutdown()
 
     asyncio.run(run())
+
+
+def test_gated_joiner_rejects_duplicated_member_list():
+    """A malicious admitted leader cannot duplicate an envelope to hand two
+    peers the same allreduce slot: joiners require strictly-sorted ids."""
+    from dedloc_tpu.core.auth import (
+        AllowlistAuthServer,
+        AllowlistAuthorizer,
+        peer_id_from_public_key,
+        wrap_request,
+    )
+    from dedloc_tpu.core.serialization import pack_obj
+
+    async def run():
+        auth_server = AllowlistAuthServer({"alice": "pw", "mallory": "pw"})
+        alice_auth = AllowlistAuthorizer(
+            "alice", "pw", auth_server.issue_token,
+            auth_server.authority_public_key,
+        )
+        mallory_auth = AllowlistAuthorizer(
+            "mallory", "pw", auth_server.issue_token,
+            auth_server.authority_public_key,
+        )
+        mallory_id = peer_id_from_public_key(mallory_auth.local_public_key)
+
+        node = await DHTNode.create(listen_host="127.0.0.1")
+        client = RPCClient(request_timeout=5.0)
+        evil_server = RPCServer("127.0.0.1", 0)
+
+        async def evil_join(peer, args):
+            token = await mallory_auth.refresh_token_if_needed()
+            ctx = args["round_id"].encode() + b"@" + mallory_id
+            me = Member(mallory_id, ("127.0.0.1", 6666), 1.0)
+            env = wrap_request(token, pack_obj(me.pack()),
+                               mallory_auth.local_private_key, context=ctx)
+            inner = {"envelopes": [env, env], "nonce": "dup"}  # duplicated!
+            return {
+                "auth": wrap_request(
+                    token, pack_obj(inner),
+                    mallory_auth.local_private_key, context=ctx,
+                )
+            }
+
+        evil_server.register("mm.join", evil_join)
+        await evil_server.start()
+        alice = Matchmaking(
+            node, client, None, "dup",
+            peer_id_from_public_key(alice_auth.local_public_key),
+            None, bandwidth=0.0, averaging_expiration=0.5,
+            authorizer=alice_auth,
+            authority_public_key=auth_server.authority_public_key,
+        )
+        try:
+            with pytest.raises(MatchmakingFailed, match="sorted"):
+                await alice._try_join(
+                    "r9", mallory_id, ("127.0.0.1", evil_server.port)
+                )
+        finally:
+            await client.close()
+            await evil_server.stop()
+            await node.shutdown()
+
+    asyncio.run(run())
