@@ -88,8 +88,15 @@ def make_accumulate_step(
 def make_apply_step(
     tx: optax.GradientTransformation,
     mesh: Optional[Mesh] = None,
+    opt_state_sharding: Optional[Any] = None,
 ) -> Callable:
-    """Build jitted (state, mean_grads) -> state'. Runs once per global step."""
+    """Build jitted (state, mean_grads) -> state'. Runs once per global step.
+
+    ``opt_state_sharding`` (a NamedSharding pytree from
+    ``parallel.zero.opt_state_shardings``) keeps optimizer moments sharded
+    ZeRO-style across updates: params/grads stay replicated, GSPMD inserts
+    whatever movement the elementwise update needs.
+    """
 
     def apply(state: TrainState, grads) -> TrainState:
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
@@ -101,7 +108,15 @@ def make_apply_step(
     kwargs = dict(donate_argnums=(0,))
     if mesh is not None:
         repl = NamedSharding(mesh, P())
-        kwargs.update(in_shardings=(repl, repl), out_shardings=repl)
+        if opt_state_sharding is not None:
+            state_sh = TrainState(
+                step=repl, params=repl, opt_state=opt_state_sharding
+            )
+            kwargs.update(
+                in_shardings=(state_sh, repl), out_shardings=state_sh
+            )
+        else:
+            kwargs.update(in_shardings=(repl, repl), out_shardings=repl)
     return jax.jit(apply, **kwargs)
 
 

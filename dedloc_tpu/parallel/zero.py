@@ -1,0 +1,74 @@
+"""ZeRO-style optimizer-state sharding over the data axis.
+
+The reference ships ZeRO/FSDP only as unused stock options
+(swav/vissl/vissl/trainer/train_zero_task.py, ClassyVision optim/zero.py —
+SURVEY.md §2.5 "present as unused options"); here the capability is native:
+optimizer moments (the 2x params HBM of LAMB/Adam) shard over the mesh's
+data axis, and XLA's GSPMD inserts the gathers the update needs. Params and
+gradients stay replicated (the collaborative averager works on full host
+gradients), so this is ZeRO stage-1: state memory / n_devices.
+
+Usage::
+
+    mesh = make_mesh(8)
+    state = TrainState.create(params, tx)
+    opt_sh = opt_state_shardings(state.opt_state, mesh)
+    state = state.replace(opt_state=shard_opt_state(state.opt_state, mesh))
+    apply = make_apply_step(tx, mesh=mesh, opt_state_sharding=opt_sh)
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _spec_for_leaf(leaf, mesh: Mesh, axis: str) -> P:
+    """Shard the largest dimension divisible by the axis size; scalars and
+    indivisible shapes replicate."""
+    n = mesh.shape[axis]
+    shape = getattr(leaf, "shape", ())
+    if not shape:
+        return P()
+    dims = sorted(range(len(shape)), key=lambda d: -shape[d])
+    for d in dims:
+        if shape[d] % n == 0 and shape[d] >= n:
+            spec = [None] * len(shape)
+            spec[d] = axis
+            return P(*spec)
+    return P()
+
+
+def opt_state_shardings(opt_state: Any, mesh: Mesh, axis: str = "data") -> Any:
+    """NamedSharding pytree for an optimizer state (ZeRO-1 layout) — feed
+    this to ``make_apply_step(opt_state_sharding=...)``."""
+    return jax.tree.map(
+        lambda l: NamedSharding(mesh, _spec_for_leaf(l, mesh, axis)),
+        opt_state,
+    )
+
+
+def shard_opt_state(opt_state: Any, mesh: Mesh, axis: str = "data") -> Any:
+    """Device-put the optimizer state with moments sharded over ``axis``."""
+    return jax.tree.map(
+        lambda l: jax.device_put(
+            l, NamedSharding(mesh, _spec_for_leaf(l, mesh, axis))
+        ),
+        opt_state,
+    )
+
+
+def opt_state_bytes_per_device(opt_state: Any, mesh: Mesh,
+                               axis: str = "data") -> int:
+    """Post-sharding per-device footprint (for memory planning/logging)."""
+    n = mesh.shape[axis]
+    total = 0
+    for leaf in jax.tree.leaves(opt_state):
+        size = int(np.prod(getattr(leaf, "shape", ()) or (1,)))
+        itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 4)
+        spec = _spec_for_leaf(leaf, mesh, axis)
+        sharded = any(s == axis for s in spec)
+        total += size * itemsize // (n if sharded else 1)
+    return total

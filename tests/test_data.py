@@ -206,3 +206,52 @@ def test_streaming_mlm_batches_end_to_end(tmp_path):
     for _ in range(30):
         batch = next(batches)
     assert batch["input_ids"].shape == (4, 64)
+
+
+def test_prepare_cli_writes_trainable_shards(tmp_path):
+    """tokenize_wikitext103 capability: prepare CLI -> shards -> trainer
+    batch stream."""
+    from dedloc_tpu.data.prepare import PrepareArguments, run_prepare
+    from dedloc_tpu.data.disk import tokenized_dataset_batches
+    from dedloc_tpu.data.tokenizer import train_unigram_tokenizer
+
+    rng = np.random.default_rng(0)
+    corpus = tmp_path / "corpus.txt"
+    words = [f"tok{i}" for i in range(50)]
+    corpus.write_text(
+        "\n".join(
+            " ".join(rng.choice(words, 25)) + ". "
+            + " ".join(rng.choice(words, 25)) + "."
+            for _ in range(40)
+        )
+    )
+    tok_path = tmp_path / "tokenizer.json"
+    from dedloc_tpu.data.tokenizer import FastTokenizer
+
+    raw = train_unigram_tokenizer(
+        corpus.read_text().splitlines(), vocab_size=300
+    )
+    raw.save(str(tok_path))
+    tok = FastTokenizer(raw)
+
+    out = tmp_path / "shards"
+    total = run_prepare(PrepareArguments(
+        input=[str(corpus)],
+        tokenizer_path=str(tok_path),
+        output_dir=str(out),
+        max_seq_length=64,
+        batch_size=8,
+        examples_per_shard=16,
+    ))
+    assert total > 0
+    import os
+    assert any(f.endswith(".bin") for f in os.listdir(out))
+
+    class Cfg:
+        vocab_size = tok.vocab_size
+        max_position_embeddings = 64
+
+    batches = tokenized_dataset_batches(str(out), Cfg, 4, 64, seed=1)
+    batch = next(batches)
+    assert batch["input_ids"].shape == (4, 64)
+    assert "mlm_labels" in batch
