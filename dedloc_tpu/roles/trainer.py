@@ -130,7 +130,12 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
     batches = _make_batches(args, cfg, public_key, slice_batch)
     data_rng = jax.random.PRNGKey(peer_shuffle_seed(public_key))
 
-    loss_sum, mini_steps = 0.0, 0
+    # the running loss stays ON DEVICE (a lazy sum) — a float() in the loop
+    # would synchronize the host with the accumulate kernels and serialize
+    # the input pipeline against XLA dispatch; the host reads one scalar per
+    # GLOBAL step, right where the value is published
+    loss_sum_dev = jnp.zeros([])
+    mini_steps = 0
     boundary = 0
     try:
         while True:
@@ -143,7 +148,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
                 grad_acc, n_acc, metrics = accumulate(
                     state.params, grad_acc, n_acc, batch, sub
                 )
-                loss_sum += float(metrics["loss"])
+                loss_sum_dev = loss_sum_dev + metrics["loss"]
                 mini_steps += 1
 
             samples = (
@@ -153,6 +158,8 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
                 state, grad_acc, n_acc, samples
             )
             if stepped:
+                loss_sum = float(loss_sum_dev)  # the one sync per global step
+                loss_sum_dev = jnp.zeros([])
                 publish_metrics(
                     dht,
                     args.dht.experiment_prefix,
@@ -172,7 +179,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
                     f"global step {opt.local_step}: loss "
                     f"{loss_sum / max(mini_steps, 1):.4f}"
                 )
-                loss_sum, mini_steps = 0.0, 0
+                mini_steps = 0
                 if (
                     args.training.save_steps
                     and opt.local_step % args.training.save_steps == 0

@@ -269,3 +269,76 @@ def test_aux_peer_joins_round():
     finally:
         trainer_opt.shutdown(); aux_opt.shutdown()
         aux_dht.shutdown(); first_dht.shutdown()
+
+
+def test_round_failure_retries_then_applies_locally():
+    """Averaging-failure contract (better than the reference's immediate
+    local apply): keep the accumulated gradients and RETRY the round up to
+    max_round_retries, then apply locally and schedule a state resync."""
+    from dedloc_tpu.collaborative.progress import CollaborationState
+    from dedloc_tpu.core.timeutils import get_dht_time
+
+    dht = DHT(start=True, listen_host="127.0.0.1")
+    tx = lamb(0.05, weight_decay=0.0)
+    opt = CollaborativeOptimizer(tx, dht, "failtoy", **_opt_kwargs())
+    try:
+        params = {"w": jnp.array([[0.5], [0.5]])}
+        state = TrainState.create(params, tx)
+        acc_fn = make_accumulate_step(_toy_loss)
+        batch = _make_problem(0)
+        grad_acc = zeros_like_grads(params)
+        n_acc = jnp.zeros([], jnp.int32)
+        grad_acc, n_acc, _ = acc_fn(
+            state.params, grad_acc, n_acc, batch, jax.random.PRNGKey(0)
+        )
+
+        # a collaboration of 2 that is always ready, but whose averaging
+        # rounds always fail (e.g. the other group member keeps dying)
+        def fake_collab(force=False):
+            return CollaborationState(
+                optimizer_step=opt.local_step,
+                samples_accumulated=10**9,
+                target_batch_size=64,
+                num_peers=2,
+                num_clients=0,
+                eta_next_step=0.0,
+                next_fetch_time=get_dht_time() + 60.0,
+            )
+
+        opt.tracker.fetch_collaboration_state = fake_collab
+        opt.averager.step = lambda *a, **k: (None, 1)
+        opt.averager.load_state_from_peers = lambda *a, **k: None
+
+        w_before = np.asarray(jax.device_get(state.params["w"]))
+        # retries: grads kept, no optimizer step
+        for attempt in range(opt.max_round_retries):
+            state, grad_acc, n_acc, stepped = opt.step(
+                state, grad_acc, n_acc, samples=16
+            )
+            assert not stepped, f"retry {attempt} must not step"
+            assert int(jax.device_get(n_acc)) == 1, "grads must be KEPT"
+            assert opt.local_step == 0
+        # final failure: apply locally, mark desynced
+        state, grad_acc, n_acc, stepped = opt.step(
+            state, grad_acc, n_acc, samples=16
+        )
+        assert stepped and opt.local_step == 1
+        assert opt._desynced, "repeated failure must schedule a resync"
+        w_after = np.asarray(jax.device_get(state.params["w"]))
+        assert not np.allclose(w_before, w_after), "local grads were applied"
+        assert int(jax.device_get(n_acc)) == 0
+
+        # next boundary: the desync triggers a catch-up attempt (no provider
+        # -> keep local state), grads reset, no step
+        grad_acc, n_acc, _ = acc_fn(
+            state.params, grad_acc, n_acc, batch, jax.random.PRNGKey(1)
+        )
+        state, grad_acc, n_acc, stepped = opt.step(
+            state, grad_acc, n_acc, samples=16
+        )
+        assert not stepped
+        assert not opt._desynced
+        assert int(jax.device_get(n_acc)) == 0, "catch-up resets accumulation"
+    finally:
+        opt.shutdown()
+        dht.shutdown()
