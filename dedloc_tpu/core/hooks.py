@@ -166,6 +166,40 @@ class LogPerfMetricsHook(Hook):
             logger.info("perf stats @ phase %d end\n%s", ctx.phase, ctx.perf.report_str())
 
 
+class DeviceStatsHook(Hook):
+    """Periodic accelerator memory stats (vissl LogGpuStatsHook /
+    LogGpuMemoryHook capability, log_hooks.py:26-113) via PJRT
+    ``memory_stats()`` — HBM in use / peak per local device. Backends that
+    expose no stats (CPU) log nothing."""
+
+    def __init__(self, log_every: int = 100):
+        self.log_every = max(1, log_every)
+
+    def on_step_end(self, ctx: LoopContext) -> None:
+        if ctx.local_step % self.log_every:
+            return
+        import jax
+
+        lines = []
+        for dev in jax.local_devices():
+            stats = getattr(dev, "memory_stats", lambda: None)()
+            if not stats:
+                continue
+            in_use = stats.get("bytes_in_use", 0) / 2**30
+            peak = stats.get("peak_bytes_in_use", 0) / 2**30
+            limit = stats.get("bytes_limit", 0) / 2**30
+            lines.append(
+                f"{dev.platform}:{dev.id} {in_use:.2f}GiB in use, "
+                f"peak {peak:.2f}GiB"
+                + (f" / {limit:.2f}GiB" if limit else "")
+            )
+        if lines:
+            logger.info(
+                "device memory @ step %d: %s", ctx.local_step,
+                " | ".join(lines),
+            )
+
+
 class CheckpointHook(Hook):
     """Periodic + phase-end checkpointing through a caller-provided save_fn.
 
