@@ -19,10 +19,16 @@ Layer map (mirrors SURVEY.md §1 of the reference analysis):
     DHT        dedloc_tpu.dht               (routing, storage, validation)
     averaging  dedloc_tpu.averaging         (matchmaking, group all-reduce)
     optimizer  dedloc_tpu.collaborative     (CollaborativeOptimizer)
-    training   dedloc_tpu.parallel          (pjit step, mesh, grad-accum)
+    training   dedloc_tpu.parallel          (pjit step, mesh, grad-accum,
+                                             ring attention, ZeRO-1)
+    kernels    dedloc_tpu.ops               (Pallas flash attention)
     models     dedloc_tpu.models            (ALBERT, ResNet-50/SwAV)
-    data       dedloc_tpu.data              (MLM+SOP, streaming, multicrop)
-    roles      dedloc_tpu.roles             (trainer / coordinator / aux / dht)
+    data       dedloc_tpu.data              (MLM+SOP, streaming, multicrop,
+                                             tokenizer, prepare CLI)
+    eval       dedloc_tpu.finetune          (NER/NCC drivers, linear probe)
+    roles      dedloc_tpu.roles             (trainer / coordinator / aux /
+                                             dht / swav / fleet)
+    auth       dedloc_tpu.core.auth         (gated-run tokens + envelopes)
 """
 
 __version__ = "0.1.0"

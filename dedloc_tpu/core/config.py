@@ -240,6 +240,7 @@ class SwAVTrainingArguments:
     save_steps: int = 0
     save_total_limit: int = 2
     log_every: int = 10
+    device_stats_every: int = 100  # HBM stats cadence (0 = off)
 
 
 @dataclass

@@ -244,11 +244,15 @@ def default_hooks(
     perf_log_every: int = 100,
     save_fn: Optional[Callable[[LoopContext], None]] = None,
     save_every: int = 0,
+    device_stats_every: int = 0,
 ) -> HookList:
     """The standard pipeline (vissl default_hook_generator capability):
-    NaN check → progress log → perf log → optional checkpointing."""
+    NaN check → progress log → perf log → optional device-memory log →
+    optional checkpointing."""
     hooks = HookList([CheckNanLossHook(), LogLossLrEtaHook(log_every),
                       LogPerfMetricsHook(perf_log_every)])
+    if device_stats_every:
+        hooks.add(DeviceStatsHook(device_stats_every))
     if save_fn is not None:
         hooks.add(CheckpointHook(save_fn, save_every))
     return hooks

@@ -204,6 +204,7 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
             log_every=t.log_every,
             save_fn=save_fn if t.save_steps else None,
             save_every=t.save_steps,
+            device_stats_every=t.device_stats_every,
         ),
     )
     try:
