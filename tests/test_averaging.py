@@ -791,3 +791,69 @@ def test_gated_joiner_rejects_duplicated_member_list():
             await node.shutdown()
 
     asyncio.run(run())
+
+
+def test_gated_client_mode_peer_joins():
+    """A firewalled (client-mode) peer in a GATED run: cannot lead, joins a
+    gated leader with its token, lands in the verified member list."""
+    from dedloc_tpu.core.auth import (
+        AllowlistAuthServer,
+        AllowlistAuthorizer,
+        peer_id_from_public_key,
+    )
+
+    async def run():
+        auth_server = AllowlistAuthServer({"alice": "pw", "carol": "pw"})
+        first = await DHTNode.create(listen_host="127.0.0.1")
+        second = await DHTNode.create(
+            listen_host="127.0.0.1", initial_peers=[first.endpoint]
+        )
+        alice_auth = AllowlistAuthorizer(
+            "alice", "pw", auth_server.issue_token,
+            auth_server.authority_public_key,
+        )
+        carol_auth = AllowlistAuthorizer(
+            "carol", "pw", auth_server.issue_token,
+            auth_server.authority_public_key,
+        )
+        client = RPCClient(request_timeout=10.0)
+        client2 = RPCClient(request_timeout=10.0)
+        server = RPCServer("127.0.0.1", 0)
+        await server.start()
+        leader = Matchmaking(
+            first, client, server, "gc",
+            peer_id_from_public_key(alice_auth.local_public_key),
+            ("127.0.0.1", server.port), bandwidth=1.0,
+            averaging_expiration=1.0,
+            authorizer=alice_auth,
+            authority_public_key=auth_server.authority_public_key,
+        )
+        carol_id = peer_id_from_public_key(carol_auth.local_public_key)
+        firewalled = Matchmaking(
+            second, client2, None, "gc", carol_id,
+            None, bandwidth=5.0,  # client mode: endpoint None, hosts nothing
+            averaging_expiration=1.0,
+            authorizer=carol_auth,
+            authority_public_key=auth_server.authority_public_key,
+        )
+        try:
+            g_leader, g_client = await asyncio.gather(
+                leader.form_group("r1"),
+                firewalled.form_group("r1"),
+            )
+            ids = {m.peer_id for m in g_leader.members}
+            assert carol_id in ids and len(ids) == 2
+            assert g_leader.nonce == g_client.nonce
+            # the client-mode member hosts nothing in the allreduce
+            carol_member = next(
+                m for m in g_client.members if m.peer_id == carol_id
+            )
+            assert carol_member.endpoint is None
+        finally:
+            await client.close()
+            await client2.close()
+            await server.stop()
+            await first.shutdown()
+            await second.shutdown()
+
+    asyncio.run(run())

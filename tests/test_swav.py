@@ -217,3 +217,50 @@ def test_swav_accumulate_step_sharded_matches_local(rng):
     flat_s = jax.tree.leaves(g_shard)
     for a, b in zip(flat_l, flat_s):
         np.testing.assert_allclose(a, b, atol=1e-3, rtol=1e-3)
+
+
+def test_swav_multi_head_prototypes(rng):
+    """Multiple prototype heads (num_prototypes tuple — the reference
+    supports K heads, swav_prototypes_head.py:85-88): loss averages over
+    heads, every head's prototypes stay L2-normalized after updates."""
+    import jax
+    import optax
+
+    from dedloc_tpu.models.swav import (
+        SwAVConfig,
+        SwAVModel,
+        SwAVTrainState,
+        make_swav_train_step,
+        normalize_prototypes,
+    )
+    from dedloc_tpu.data.multicrop import MultiCropSpec, synthetic_multicrop_batches
+
+    cfg = SwAVConfig.tiny(num_prototypes=(16, 24))
+    spec = MultiCropSpec.tiny()
+    model = SwAVModel(cfg)
+    crops = [jnp.asarray(c) for c in
+             next(synthetic_multicrop_batches(spec, 4, seed=0))]
+    variables = model.init(jax.random.PRNGKey(0), crops, True)
+    _, scores = model.apply(
+        {"params": variables["params"],
+         "batch_stats": variables["batch_stats"]},
+        crops, False,
+    )
+    assert [s.shape[-1] for s in scores] == [16, 24]
+
+    tx = optax.sgd(0.1)
+    params = normalize_prototypes(variables["params"])
+    state = SwAVTrainState(
+        step=jnp.zeros([], jnp.int32),
+        params=params,
+        batch_stats=variables["batch_stats"],
+        opt_state=tx.init(params),
+        queue=None,
+    )
+    step = make_swav_train_step(model, cfg, tx)
+    state, metrics = step(state, crops, False)
+    assert np.isfinite(float(metrics["loss"]))
+    for h in range(2):
+        kernel = state.params["head"][f"prototypes{h}"]["kernel"]
+        norms = np.linalg.norm(np.asarray(kernel), axis=0)
+        np.testing.assert_allclose(norms, 1.0, atol=1e-5)
