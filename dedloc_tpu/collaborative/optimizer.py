@@ -78,6 +78,7 @@ class CollaborativeOptimizer:
         prefix: str,
         target_batch_size: int = 4096,
         batch_size_per_step: Optional[int] = None,
+        batch_size_lead: int = 0,
         bandwidth: float = 1000.0,
         compression: str = "float16",
         target_group_size: int = 256,
@@ -140,6 +141,7 @@ class CollaborativeOptimizer:
             metadata_expiration=metadata_expiration,
             expected_drift_peers=expected_drift_peers,
             expected_drift_rate=expected_drift_rate,
+            batch_size_lead=batch_size_lead,
         )
         self.performance_ema = PerformanceEMA(alpha=performance_ema_alpha)
         self._ema_started = False

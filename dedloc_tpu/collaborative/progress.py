@@ -51,10 +51,17 @@ class CollaborationState:
     num_clients: int
     eta_next_step: float  # seconds
     next_fetch_time: float  # dht time
+    # start the round this many samples EARLY so matchmaking latency
+    # overlaps the tail of accumulation (the reference's batch_size_lead,
+    # albert/arguments.py CollaborativeOptimizerArguments)
+    batch_size_lead: int = 0
 
     @property
     def ready_for_step(self) -> bool:
-        return self.samples_accumulated >= self.target_batch_size
+        return (
+            self.samples_accumulated
+            >= self.target_batch_size - self.batch_size_lead
+        )
 
 
 class ProgressTracker:
@@ -70,11 +77,20 @@ class ProgressTracker:
         metadata_expiration: float = 30.0,
         expected_drift_peers: float = 3.0,
         expected_drift_rate: float = 0.2,
+        batch_size_lead: int = 0,
     ):
+        if not 0 <= batch_size_lead < target_batch_size:
+            # lead >= target would make every step ready at zero samples —
+            # a busy-loop of zero-gradient optimizer steps; fail at startup
+            raise ValueError(
+                f"batch_size_lead ({batch_size_lead}) must be in "
+                f"[0, target_batch_size={target_batch_size})"
+            )
         self.dht = dht
         self.key = f"{prefix}_progress"
         self.peer_subkey = peer_subkey
         self.target_batch_size = target_batch_size
+        self.batch_size_lead = batch_size_lead
         self.min_refresh_period = min_refresh_period
         self.max_refresh_period = max_refresh_period
         self.default_refresh_period = default_refresh_period
@@ -141,8 +157,13 @@ class ProgressTracker:
         # throughput below the floor means "not yet measured" (a fresh peer's
         # EMA), NOT a multi-year ETA — treat the ETA as unknown so the refresh
         # period falls back to the default instead of pinning at the maximum
+        # ETA to the READY point — target minus lead, so the adaptive poll
+        # cadence tightens in time to catch the (earlier) round start
         eta = (
-            max(0.0, self.target_batch_size - total_samples) / total_sps
+            max(
+                0.0,
+                self.target_batch_size - self.batch_size_lead - total_samples,
+            ) / total_sps
             if num_peers and total_sps > 1e-6
             else float("inf")
         )
@@ -162,4 +183,5 @@ class ProgressTracker:
             num_clients=num_clients,
             eta_next_step=eta,
             next_fetch_time=self._next_fetch,
+            batch_size_lead=self.batch_size_lead,
         )

@@ -57,6 +57,7 @@ def run_aux(
         dht,
         prefix=args.dht.experiment_prefix,
         target_batch_size=args.optimizer.target_batch_size,
+        batch_size_lead=args.optimizer.batch_size_lead,
         bandwidth=args.averager.bandwidth,
         compression=args.averager.compression,
         target_group_size=args.averager.target_group_size,

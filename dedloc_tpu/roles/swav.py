@@ -109,6 +109,7 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
         dht,
         prefix=args.dht.experiment_prefix,
         target_batch_size=args.optimizer.target_batch_size,
+        batch_size_lead=args.optimizer.batch_size_lead,
         batch_size_per_step=(
             slice_batch * t.gradient_accumulation_steps
         ),

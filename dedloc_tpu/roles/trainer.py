@@ -95,6 +95,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         batch_size_per_step=(
             slice_batch * args.training.gradient_accumulation_steps
         ),
+        batch_size_lead=args.optimizer.batch_size_lead,
         bandwidth=args.averager.bandwidth,
         compression=args.averager.compression,
         target_group_size=args.averager.target_group_size,
