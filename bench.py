@@ -22,6 +22,13 @@ import os
 import time
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # honor an explicit CPU request: the container's sitecustomize pins
+    # jax_platforms to the TPU plugin, so the env var alone is not enough
+    # (same workaround as tests/conftest.py and __graft_entry__.py)
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 import numpy as np
 

@@ -1,0 +1,39 @@
+"""Driver-facing contracts: bench.py's single JSON line and the graft
+entry's jittable forward."""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+
+
+def test_bench_tiny_prints_one_json_line():
+    env = dict(
+        os.environ,
+        DEDLOC_BENCH_TINY="1",
+        JAX_PLATFORMS="cpu",
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py")],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    json_lines = [
+        l for l in out.stdout.strip().splitlines() if l.startswith("{")
+    ]
+    assert len(json_lines) == 1, out.stdout
+    record = json.loads(json_lines[0])
+    assert set(record) == {"metric", "value", "unit", "vs_baseline"}
+    assert record["value"] > 0
+
+
+def test_graft_entry_compiles():
+    # the path entry must survive entry()'s lazy dedloc_tpu imports
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    shapes = jax.eval_shape(fn, *args)
+    assert shapes is not None

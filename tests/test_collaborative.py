@@ -415,3 +415,43 @@ def test_batch_size_lead_validated():
             )
     finally:
         dht.shutdown()
+
+
+def test_solo_collaborative_loop_converges():
+    """Capstone: the FULL collaborative loop (accumulate -> progress ->
+    matchmaking -> group-of-one round -> LAMB apply) actually optimizes —
+    loss on the toy regression drops by >3x over 25 global steps."""
+    dht = DHT(start=True, listen_host="127.0.0.1")
+    tx = lamb(0.1, weight_decay=0.0)
+    opt = CollaborativeOptimizer(
+        tx, dht, "conv", **_opt_kwargs(target_batch_size=16,
+                                       averaging_expiration=0.2)
+    )
+    try:
+        params = {"w": jnp.array([[0.0], [0.0]])}
+        state = TrainState.create(params, tx)
+        acc_fn = make_accumulate_step(_toy_loss)
+        batch = _make_problem(0)
+        first_loss = last_loss = None
+        grad_acc = zeros_like_grads(params)
+        n_acc = jnp.zeros([], jnp.int32)
+        steps = 0
+        deadline = time.time() + 90
+        while steps < 25 and time.time() < deadline:
+            grad_acc, n_acc, metrics = acc_fn(
+                state.params, grad_acc, n_acc, batch, jax.random.PRNGKey(0)
+            )
+            if first_loss is None:
+                first_loss = float(metrics["loss"])
+            last_loss = float(metrics["loss"])
+            state, grad_acc, n_acc, stepped = opt.step(
+                state, grad_acc, n_acc, samples=16
+            )
+            steps += stepped
+        assert steps == 25
+        # LAMB's trust-ratio scaling is conservative on a 2-parameter toy;
+        # >3x in 25 steps is a robust convergence signal without flakiness
+        assert last_loss < first_loss / 3, (first_loss, last_loss)
+    finally:
+        opt.shutdown()
+        dht.shutdown()
