@@ -24,7 +24,8 @@ import dataclasses
 import hmac
 import os
 import random
-from typing import Awaitable, Callable, Dict, Optional, TypeVar
+from collections import deque
+from typing import Awaitable, Callable, Deque, Dict, Optional, Tuple, TypeVar
 
 from dedloc_tpu.core.timeutils import get_dht_time
 from dedloc_tpu.dht.crypto import RSAPrivateKey, verify_signature
@@ -231,9 +232,15 @@ class AllowlistAuthorizer(TokenAuthorizerBase):
 def _envelope_signing_bytes(
     payload: bytes, nonce: bytes, timestamp: float, context: bytes = b""
 ) -> bytes:
-    return (
-        context + b"|" + payload + b"|" + nonce + b"|"
-        + repr(float(timestamp)).encode()
+    # Length-prefix every variable-length field so the signed encoding is
+    # unambiguous: payload and nonce are unconstrained bytes, and a
+    # delimiter-joined encoding would let an attacker shift the
+    # payload/nonce boundary whenever the nonce happened to contain the
+    # delimiter, defeating the replay guard's nonce memory.
+    ts_bytes = repr(float(timestamp)).encode()
+    return b"".join(
+        len(field).to_bytes(8, "big") + field
+        for field in (context, payload, nonce, ts_bytes)
     )
 
 
@@ -263,20 +270,28 @@ def wrap_request(
 
 
 class ReplayGuard:
-    """Remembers recently-seen nonces within the freshness window."""
+    """Remembers recently-seen nonces within the freshness window.
+
+    Nonces are kept both in a set (O(1) membership) and in an
+    insertion-ordered deque of ``(first_seen, nonce)``; because ``now`` is
+    monotone across calls the deque stays time-sorted, so each call only
+    pops the aged prefix — O(1) amortized instead of a full-dict rebuild
+    per request on the leader's admission path."""
 
     def __init__(self, max_age: float = 60.0):
         self.max_age = max_age
-        self._seen: Dict[bytes, float] = {}
+        self._seen: set = set()
+        self._order: Deque[Tuple[float, bytes]] = deque()
 
     def check_and_remember(self, nonce: bytes, now: float) -> bool:
         """False if the nonce was already seen (replay). Expires old ones."""
-        for n, t in list(self._seen.items()):
-            if now - t > self.max_age:
-                del self._seen[n]
+        while self._order and now - self._order[0][0] > self.max_age:
+            _, old = self._order.popleft()
+            self._seen.discard(old)
         if nonce in self._seen:
             return False
-        self._seen[nonce] = now
+        self._seen.add(nonce)
+        self._order.append((now, nonce))
         return True
 
 

@@ -86,11 +86,13 @@ class LocalFleet:
 
     def _spawn(self, name: str, module: str, flags: List[str]) -> None:
         env = dict(os.environ, DEDLOC_FORCE_CPU="1")
-        log = open(os.path.join(self.args.output_dir, f"{name}.log"), "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", module, *flags],
-            env=env, stdout=log, stderr=subprocess.STDOUT,
-        )
+        # the child duplicates the descriptor; close the parent's handle so
+        # churn respawns don't leak one fd per spawn
+        with open(os.path.join(self.args.output_dir, f"{name}.log"), "ab") as log:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", module, *flags],
+                env=env, stdout=log, stderr=subprocess.STDOUT,
+            )
         self.procs[name] = proc
         self.events.append({"t": time.time(), "event": "spawn", "peer": name})
         logger.info(f"spawned {name} (pid {proc.pid})")

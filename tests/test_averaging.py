@@ -640,6 +640,9 @@ def test_gated_join_rejects_impersonated_member_id():
             authority_public_key=auth_server.authority_public_key,
         )
         try:
+            # seed a led round: joins for rounds the peer never led are
+            # rejected before any envelope cryptography runs
+            mm._leading["r1"] = ({}, {}, asyncio.Event(), "nonce1")
             # mallory holds a VALID token but claims the leader's peer_id
             token = await mallory_auth.refresh_token_if_needed()
             forged = Member(leader_id, ("127.0.0.1", 1), 999.0)
