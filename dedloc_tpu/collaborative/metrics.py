@@ -24,13 +24,26 @@ from dedloc_tpu.dht.validation import (
 
 
 class LocalMetrics(BaseModel):
-    """Reference: LocalMetrics(BaseModel) at albert/metrics_utils.py:9-15."""
+    """Reference: LocalMetrics(BaseModel) at albert/metrics_utils.py:9-15.
+
+    The optional telemetry tail is the vissl PerfStats capability
+    (vissl/utils/perf_stats.py:12-249) carried over the metrics bus: per-peer
+    step-phase timings + HBM occupancy, aggregated by the coordinator into
+    its JSONL. Optional so peers without telemetry enabled (and round-1
+    records) still validate."""
 
     step: StrictInt
     samples_per_second: StrictFloat
     samples_accumulated: StrictInt
     loss: StrictFloat
     mini_steps: StrictInt
+    step_time_ms: Optional[StrictFloat] = None  # accumulation-boundary wall
+    data_wait_ms: Optional[StrictFloat] = None  # host input-pipeline stall
+    allreduce_ms: Optional[StrictFloat] = None  # averaging round (stepped only)
+    hbm_bytes: Optional[StrictInt] = None  # device bytes_in_use
+    # filled by fetch_metrics from the signed DHT subkey, never by peers:
+    # a stable fingerprint so the coordinator can attribute stragglers
+    peer: Optional[str] = None
 
 
 class MetricSchema(BaseModel):
@@ -75,12 +88,20 @@ def fetch_metrics(dht: DHT, prefix: str) -> List[LocalMetrics]:
     out: List[LocalMetrics] = []
     if entry is None or not hasattr(entry.value, "items"):
         return out
-    for _subkey, v in entry.value.items():
+    import hashlib
+
+    for subkey, v in entry.value.items():
         try:
             payload = v.value
             if isinstance(payload, (bytes, bytearray)):
                 payload = unpack_obj(payload)
-            out.append(LocalMetrics.model_validate(payload))
+            record = LocalMetrics.model_validate(payload)
+            raw = subkey if isinstance(subkey, bytes) else str(subkey).encode()
+            out.append(
+                record.model_copy(
+                    update={"peer": hashlib.sha1(raw).hexdigest()[:12]}
+                )
+            )
         except Exception:  # noqa: BLE001 — skip malformed peer records
             continue
     return out
@@ -96,7 +117,7 @@ def aggregate_metrics(records: List[LocalMetrics]) -> Optional[dict]:
     current = [m for m in records if m.step == current_step]
     sum_mini = sum(m.mini_steps for m in current)
     sum_loss = sum(m.loss for m in current)
-    return {
+    agg = {
         "step": current_step,
         "alive_peers": len(records),
         "samples_accumulated": sum(m.samples_accumulated for m in current),
@@ -104,3 +125,18 @@ def aggregate_metrics(records: List[LocalMetrics]) -> Optional[dict]:
         "loss": (sum_loss / sum_mini) if sum_mini else 0.0,
         "mini_steps": sum_mini,
     }
+    telemetry = [
+        {
+            "peer": m.peer,
+            "samples_per_second": m.samples_per_second,
+            "step_time_ms": m.step_time_ms,
+            "data_wait_ms": m.data_wait_ms,
+            "allreduce_ms": m.allreduce_ms,
+            "hbm_bytes": m.hbm_bytes,
+        }
+        for m in current
+        if m.step_time_ms is not None
+    ]
+    if telemetry:
+        agg["peer_telemetry"] = telemetry
+    return agg

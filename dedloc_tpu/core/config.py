@@ -176,6 +176,10 @@ class TrainingArguments:
     (albert/arguments.py:104-128)."""
 
     model_size: str = "large"  # tiny (CI fixture) | large
+    remat_policy: str = ""  # override model remat: nothing|dots|dots_no_batch|full
+    attention_impl: str = ""  # override: dense|blockwise|flash|ring
+    vocab_size: int = 0  # override model vocab (0 = size default); must cover
+    # the dataset tokenizer's vocab (checked against the shard dir's meta.json)
     dataset_path: str = ""  # tokenized dataset dir; empty = synthetic fixture
     # streaming mode (sahajbert capability): one-document-per-line text
     # files mixed by weight, tokenized on the fly (needs tokenizer_path)
@@ -202,6 +206,9 @@ class TrainingArguments:
     output_dir: str = "outputs"
     save_steps: int = 500
     save_total_limit: int = 2
+    # telemetry (vissl PerfStats capability on the flagship path):
+    train_log_path: str = ""  # per-global-step JSONL: wall/step/loss/phases
+    log_perf_steps: int = 0  # log a PerfStats phase report every N global steps
 
 
 @dataclass

@@ -74,6 +74,20 @@ def tokenized_dataset_batches(
     )
     if not shards:
         raise FileNotFoundError(f"no shard-*.bin files under {path}")
+    meta_path = os.path.join(path, "meta.json")
+    if os.path.exists(meta_path):
+        import json
+
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if meta.get("vocab_size", 0) > cfg.vocab_size:
+            raise ValueError(
+                f"dataset at {path} was tokenized with vocab "
+                f"{meta['vocab_size']} but the model's vocab_size is only "
+                f"{cfg.vocab_size}; out-of-range ids would corrupt the "
+                f"embedding lookup. Use --training.vocab_size "
+                f"{meta['vocab_size']} or retokenize."
+            )
     rng = np.random.default_rng(seed)
     tokens = SpecialTokens(vocab_size=cfg.vocab_size)
     seq_length = min(seq_length, cfg.max_position_embeddings)

@@ -108,6 +108,22 @@ def run_prepare(args: PrepareArguments) -> int:
         ),
         examples_per_shard=args.examples_per_shard,
     )
+    # dataset metadata: lets the trainer fail fast when the model's vocab is
+    # smaller than the tokenizer's (out-of-range embedding lookups otherwise
+    # surface as NaN params a full global step later)
+    import json
+    import os
+
+    with open(os.path.join(args.output_dir, "meta.json"), "w") as f:
+        json.dump(
+            {
+                "vocab_size": tok.vocab_size,
+                "max_seq_length": args.max_seq_length,
+                "num_instances": total,
+                "tokenizer_path": args.tokenizer_path,
+            },
+            f,
+        )
     logger.info(
         f"wrote {total} instances to {args.output_dir} "
         f"(max_seq_length={args.max_seq_length})"

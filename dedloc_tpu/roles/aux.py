@@ -37,8 +37,14 @@ def run_aux(
 ) -> int:
     """Returns the number of averaging rounds joined (for tests)."""
     force_cpu_if_requested()
-    # aux needs only gradient SHAPES, never runs the model
-    cfg, model = build_model(args.training.model_size)
+    # aux needs only gradient SHAPES, never runs the model — but they must
+    # match the trainers' exactly, so apply the same config overrides
+    cfg, model = build_model(
+        args.training.model_size,
+        args.training.remat_policy,
+        args.training.attention_impl,
+        args.training.vocab_size,
+    )
     seq = min(args.training.seq_length, cfg.max_position_embeddings)
     params = jax.eval_shape(
         lambda r: model.init(r, jnp.zeros((1, seq), jnp.int32))["params"],

@@ -31,8 +31,21 @@ def force_cpu_if_requested() -> None:
         jax.config.update("jax_platforms", "cpu")
 
 
-def build_model(model_size: str) -> Tuple[AlbertConfig, AlbertForPreTraining]:
-    cfg = AlbertConfig.tiny() if model_size == "tiny" else AlbertConfig.large()
+def build_model(
+    model_size: str,
+    remat_policy: str = "",
+    attention_impl: str = "",
+    vocab_size: int = 0,
+) -> Tuple[AlbertConfig, AlbertForPreTraining]:
+    overrides = {}
+    if remat_policy:
+        overrides["remat_policy"] = remat_policy
+    if attention_impl:
+        overrides["attention_impl"] = attention_impl
+    if vocab_size:
+        overrides["vocab_size"] = vocab_size
+    make = AlbertConfig.tiny if model_size == "tiny" else AlbertConfig.large
+    cfg = make(**overrides)
     return cfg, AlbertForPreTraining(cfg)
 
 

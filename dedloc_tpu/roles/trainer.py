@@ -13,6 +13,8 @@ accumulation boundary, not per micro-batch (SURVEY.md §7 hard-part b).
 """
 from __future__ import annotations
 
+import json
+import time
 from typing import Optional
 
 import jax
@@ -38,13 +40,19 @@ from dedloc_tpu.roles.common import (
 )
 from dedloc_tpu.utils.checkpoint import load_latest_checkpoint, save_checkpoint
 from dedloc_tpu.utils.logging import get_logger
+from dedloc_tpu.utils.perf import PerfStats
 
 logger = get_logger(__name__)
 
 
 def run_trainer(args: CollaborationArguments) -> TrainState:
     force_cpu_if_requested()
-    cfg, model = build_model(args.training.model_size)
+    cfg, model = build_model(
+        args.training.model_size,
+        args.training.remat_policy,
+        args.training.attention_impl,
+        args.training.vocab_size,
+    )
     tx = build_optimizer(args)
     dht, public_key = build_dht(args)
     logger.info(f"trainer DHT listening on {dht.port}")
@@ -138,11 +146,27 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
     loss_sum_dev = jnp.zeros([])
     mini_steps = 0
     boundary = 0
+    # telemetry: phase timers on the flagship path (vissl PerfStats
+    # capability, vissl/utils/perf_stats.py:12-249). data_wait and the
+    # boundary wall are host-honest; per-micro-batch device time is NOT
+    # blocked on (that would serialize the async dispatch chain) — it shows
+    # up in the boundary wall instead.
+    perf = PerfStats()
+    train_log = (
+        open(args.training.train_log_path, "a", buffering=1)
+        if args.training.train_log_path
+        else None
+    )
+    wall_start = time.perf_counter()
     try:
         while True:
             # one accumulation boundary = gradient_accumulation_steps micro-batches
+            boundary_start = time.perf_counter()
+            data_wait = 0.0
             for _ in range(args.training.gradient_accumulation_steps):
+                t0 = time.perf_counter()
                 batch = drop_collator_keys(next(batches))
+                data_wait += time.perf_counter() - t0
                 if mesh is not None:
                     batch = put_batch(batch, mesh)
                 data_rng, sub = jax.random.split(data_rng)
@@ -151,28 +175,44 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
                 )
                 loss_sum_dev = loss_sum_dev + metrics["loss"]
                 mini_steps += 1
+            # per-BOUNDARY stall so it is directly comparable to the
+            # boundary wall time below
+            perf.metric("data_wait").update(data_wait)
 
             samples = (
                 slice_batch * args.training.gradient_accumulation_steps
             )
+            t0 = time.perf_counter()
             state, grad_acc, n_acc, stepped = opt.step(
                 state, grad_acc, n_acc, samples
+            )
+            # most boundaries are a cheap DHT progress report; the averaging
+            # round only happens when the collaboration steps — keep the two
+            # in separate metrics or the round cost is diluted ~targetN x
+            perf.metric("allreduce" if stepped else "collab_report").update(
+                time.perf_counter() - t0
+            )
+            perf.metric("boundary").update(
+                time.perf_counter() - boundary_start
             )
             if stepped:
                 loss_sum = float(loss_sum_dev)  # the one sync per global step
                 loss_sum_dev = jnp.zeros([])
+                sps = float(opt.performance_ema.samples_per_second)
                 publish_metrics(
                     dht,
                     args.dht.experiment_prefix,
                     public_key,
                     LocalMetrics(
                         step=opt.local_step,
-                        samples_per_second=float(
-                            opt.performance_ema.samples_per_second
-                        ),
+                        samples_per_second=sps,
                         samples_accumulated=samples,
                         loss=loss_sum,
                         mini_steps=mini_steps,
+                        step_time_ms=perf.metric("boundary").recent_mean * 1e3,
+                        data_wait_ms=perf.metric("data_wait").recent_mean * 1e3,
+                        allreduce_ms=perf.metric("allreduce").recent_mean * 1e3,
+                        hbm_bytes=_hbm_bytes_in_use(),
                     ),
                     expiration=args.optimizer.statistics_expiration,
                 )
@@ -180,6 +220,36 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
                     f"global step {opt.local_step}: loss "
                     f"{loss_sum / max(mini_steps, 1):.4f}"
                 )
+                if train_log is not None:
+                    train_log.write(
+                        json.dumps(
+                            {
+                                "wall_s": time.perf_counter() - wall_start,
+                                "step": opt.local_step,
+                                "loss": loss_sum / max(mini_steps, 1),
+                                "samples_per_second": sps,
+                                "samples": samples,
+                                "boundary_ms": perf.metric(
+                                    "boundary"
+                                ).recent_mean
+                                * 1e3,
+                                "data_wait_ms": perf.metric(
+                                    "data_wait"
+                                ).recent_mean
+                                * 1e3,
+                                "allreduce_ms": perf.metric(
+                                    "allreduce"
+                                ).recent_mean
+                                * 1e3,
+                            }
+                        )
+                        + "\n"
+                    )
+                if (
+                    args.training.log_perf_steps
+                    and opt.local_step % args.training.log_perf_steps == 0
+                ):
+                    logger.info("perf phases:\n" + perf.report_str())
                 mini_steps = 0
                 if (
                     args.training.save_steps
@@ -195,9 +265,22 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
                 logger.info(f"reached max_local_steps={boundary}; stopping")
                 break
     finally:
+        if train_log is not None:
+            train_log.close()
         opt.shutdown()
         dht.shutdown()
     return state
+
+
+def _hbm_bytes_in_use() -> Optional[int]:
+    """Device bytes_in_use via PJRT memory_stats (None off-TPU/unsupported)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            return int(stats.get("bytes_in_use", 0)) or None
+    except Exception:  # noqa: BLE001 — telemetry must never kill training
+        pass
+    return None
 
 
 def _save(args: CollaborationArguments, state: TrainState, step: int) -> None:
