@@ -145,6 +145,7 @@ class CollaborativeOptimizer:
         )
         self.performance_ema = PerformanceEMA(alpha=performance_ema_alpha)
         self._ema_started = False
+        self._created_at = get_dht_time()
         self.local_step = 0
         self.local_samples_accumulated = 0
         self.mesh = mesh
@@ -154,7 +155,20 @@ class CollaborativeOptimizer:
         # swav_hooks.py:55-92); runs once per GLOBAL step inside jit
         self.post_apply = post_apply
         self._lock = threading.Lock()
-        self._last_good: Optional[Tuple[Any, int]] = None  # host (params, opt)
+        # the state backup (device_get of params+opt_state) runs on this
+        # thread, OFF the critical path: it is read-only w.r.t. the next
+        # round's gradients, so the next accumulation phase overlaps it
+        # (SURVEY.md §7 hard-part b; seam cost published in BASELINE.md)
+        self._backup_thread: Optional[threading.Thread] = None
+        # the backup transfer may use at most this fraction of wall time, so
+        # a slow device↔host link (e.g. a tunneled dev chip: ~10 MB/s vs
+        # PCIe's GB/s) degrades to periodic backups instead of serializing
+        # every global step behind a full state download
+        self.backup_duty_cycle = 0.5
+        self._backup_done_at = 0.0
+        self._backup_took = 0.0
+        # jit↔host seam telemetry (ms, last global step)
+        self.seam_ms: Dict[str, float] = {}
         self._desynced = False
         self._round_failures = 0
         self.max_round_retries = 2
@@ -210,6 +224,14 @@ class CollaborativeOptimizer:
             if not collab.ready_for_step:
                 return state, grad_acc, n_acc, False
 
+            # decide the round shape on a FORCED-fresh view: the cached view
+            # can lag a just-joined peer, and the solo fast path below must
+            # not fire while a partner is mid-round
+            collab = self.tracker.fetch_collaboration_state(force=True)
+            if collab.optimizer_step > self.local_step:
+                return state, grad_acc, n_acc, False  # catch up next boundary
+            if not collab.ready_for_step:
+                return state, grad_acc, n_acc, False
             return self._global_step(state, grad_acc, n_acc, collab)
 
     def _report(self, synced: bool) -> None:
@@ -228,13 +250,47 @@ class CollaborativeOptimizer:
         round_id = f"step{collab.optimizer_step}"
         n = max(int(jax.device_get(n_acc)), 1)
         mean_grads = jax.tree.map(lambda g: g / n, grad_acc)
-        named = _tree_to_named(mean_grads)
+
+        alone_grace = (
+            get_dht_time() - self._created_at
+            >= self.tracker.metadata_expiration
+        )
+        if collab.num_peers <= 1 and not self.client_mode and alone_grace:
+            # alone in the collaboration: the group all-reduce is the
+            # identity, so the gradients never leave the device — no
+            # device_get, no wire codec, no matchmaking window. A peer that
+            # joins later shows up in the tracker and the next boundary takes
+            # the full averaging path. (The reference pays hivemind's full
+            # round machinery even solo; this is the TPU-native win of
+            # keeping the apply on-device.)
+            #
+            # The grace period guards the cold-start race: any peer that was
+            # alive recently still has an unexpired progress record (so
+            # num_peers > 1), but a peer started in the last few seconds may
+            # not have a visible record yet — until one full record lifetime
+            # has passed, take the networked path, whose straggler window
+            # lets a concurrent starter pair with us.
+            self.seam_ms.pop("grads_device_get", None)
+            return self._apply_and_advance(
+                state, mean_grads, collab, group_size=1
+            )
+
+        t0 = time.perf_counter()
+        named = _tree_to_named(mean_grads)  # device_get of the full grad tree
+        self.seam_ms["grads_device_get"] = (time.perf_counter() - t0) * 1e3
 
         self.performance_ema.pause()
         try:
             averaged, group_size = self.averager.step(
                 named, weight=float(self.local_samples_accumulated), round_id=round_id
             )
+            if averaged is not None and group_size == 1 and collab.num_peers > 1:
+                # we formed a group of one while partners exist: they may be
+                # averaging without us this round, and applying our local
+                # grads now would diverge the replicas. Treat it as a failed
+                # round — the retry keeps the grads; repeated misses fall
+                # back to local-apply + resync below.
+                averaged = None
             if averaged is not None:
                 mean_grads = _named_to_tree(averaged, mean_grads)
                 self._round_failures = 0
@@ -261,26 +317,44 @@ class CollaborativeOptimizer:
                         f"{round_id}: averaging failed repeatedly — applying "
                         "local grads, will resync"
                     )
-            new_state = self._apply_fn(state, mean_grads)
-            if self.post_apply is not None:
-                new_state = self.post_apply(new_state)
-            if not bool(params_are_finite(new_state.params)):
-                # NaN guard (CollaborativeCallback.on_step_end semantics,
-                # albert/run_trainer.py:134-137): discard this update
-                logger.warning(f"{round_id}: non-finite params; rolling back")
-                new_state = self._rollback(new_state)
-            self.local_step = collab.optimizer_step + 1
-            self.local_samples_accumulated = 0
-            self._backup_and_share(new_state)
-            self._report(synced=True)
-            self.tracker.fetch_collaboration_state(force=True)
-            if self.verbose:
-                logger.info(
-                    f"global step {self.local_step} applied "
-                    f"(group={group_size}, samples~{collab.samples_accumulated})"
-                )
+            return self._apply_and_advance(state, mean_grads, collab, group_size)
         finally:
             self.performance_ema.resume()
+
+    def _apply_and_advance(self, state: TrainState, mean_grads, collab,
+                           group_size: int):
+        """Optimizer apply + NaN guard + backup + progress bookkeeping —
+        the tail of a global step, shared by the solo and networked paths."""
+        round_id = f"step{collab.optimizer_step}"
+        t0 = time.perf_counter()
+        # NaN-rollback backup stays ON DEVICE: an HBM copy of the pre-apply
+        # state costs ~ms, where a host round-trip of the same bytes costs
+        # seconds (and competes with the dispatch stream for PCIe). The copy
+        # is required because apply donates the input buffers.
+        pre = jax.tree.map(
+            jax.numpy.copy, (state.step, state.params, state.opt_state)
+        )
+        new_state = self._apply_fn(state, mean_grads)
+        if self.post_apply is not None:
+            new_state = self.post_apply(new_state)
+        if not bool(params_are_finite(new_state.params)):
+            # NaN guard (CollaborativeCallback.on_step_end semantics,
+            # albert/run_trainer.py:134-137): discard this update
+            logger.warning(f"{round_id}: non-finite params; rolling back")
+            new_state = new_state.replace(
+                step=pre[0], params=pre[1], opt_state=pre[2]
+            )
+        self.seam_ms["apply"] = (time.perf_counter() - t0) * 1e3
+        self.local_step = collab.optimizer_step + 1
+        self.local_samples_accumulated = 0
+        self._backup_and_share(new_state)
+        self._report(synced=True)
+        self.tracker.fetch_collaboration_state(force=True)
+        if self.verbose:
+            logger.info(
+                f"global step {self.local_step} applied "
+                f"(group={group_size}, samples~{collab.samples_accumulated})"
+            )
         return (
             new_state,
             zeros_like_grads(new_state.params),
@@ -291,31 +365,65 @@ class CollaborativeOptimizer:
     # -------------------------------------------------------- state recovery
 
     def _backup_and_share(self, state: TrainState) -> None:
-        """One device_get per global step serves both the NaN-rollback backup
-        (run_trainer.py:172-186) and the shared state for late joiners."""
-        host_state = jax.device_get((state.params, state.opt_state))
-        self._last_good = (host_state, int(state.step))
-        if self.averager.allow_state_sharing:
+        """Host snapshot of (params, opt_state) for late joiners
+        (load_state_from_peers counterpart, run_trainer.py:124-128). The
+        NaN-rollback backup is NOT here — it lives on device
+        (see ``_apply_and_advance``) — so this transfer is pure state
+        sharing and can be skipped entirely when sharing is off.
+
+        Runs on a background thread: the transfer is read-only w.r.t. the
+        next round (a fresh grad accumulator), so the next accumulation phase
+        overlaps the hundreds of MB of device→host traffic instead of
+        stalling behind it.
+
+        Duty-cycle cap: when the transfer takes longer than
+        ``backup_duty_cycle`` of the time between global steps, skip this
+        step's snapshot instead of queueing behind it — late joiners get a
+        slightly older state, training throughput stays intact. (On PCIe the
+        transfer is ~ms and effectively every step is shared; the cap only
+        bites on slow links.)
+        """
+        if not self.averager.allow_state_sharing:
+            return
+        if self._backup_thread is not None and self._backup_thread.is_alive():
+            return  # previous snapshot still draining; don't stall the step
+        now = time.perf_counter()
+        idle_needed = self._backup_took * (1.0 / self.backup_duty_cycle - 1.0)
+        if now < self._backup_done_at + idle_needed:
+            return
+        self._join_backup()
+        step, local_step = int(state.step), self.local_step
+        # snapshot ON DEVICE first (an HBM copy, ~ms): the next global step's
+        # apply DONATES state's buffers, so the thread must never hold the
+        # live arrays — device_get on a donated buffer would raise "Array has
+        # been deleted" mid-transfer on exactly the slow links the duty cycle
+        # exists for
+        snapshot = jax.tree.map(
+            jax.numpy.copy, (state.params, state.opt_state)
+        )
+
+        def backup() -> None:
+            t0 = time.perf_counter()
+            host_state = jax.device_get(snapshot)
             self.averager.set_shared_state(
                 _tree_to_named(host_state),
-                {"step": int(state.step), "local_step": self.local_step},
+                {"step": step, "local_step": local_step},
             )
             self.averager.publish_state_provider(
                 expiration=self.tracker.metadata_expiration * 4,
-                step=self.local_step,
+                step=local_step,
             )
+            end = time.perf_counter()
+            self._backup_done_at, self._backup_took = end, end - t0
+            self.seam_ms["backup"] = (end - t0) * 1e3
 
-    def _rollback(self, state: TrainState) -> TrainState:
-        if self._last_good is None:
-            raise FloatingPointError(
-                "non-finite parameters and no backup to roll back to"
-            )
-        (params, opt_state), step = self._last_good
-        return state.replace(
-            step=jax.numpy.asarray(step, jax.numpy.int32),
-            params=self._device_put(params),
-            opt_state=self._device_put(opt_state),
-        )
+        self._backup_thread = threading.Thread(target=backup, daemon=True)
+        self._backup_thread.start()
+
+    def _join_backup(self) -> None:
+        if self._backup_thread is not None:
+            self._backup_thread.join()
+            self._backup_thread = None
 
     def _device_put(self, tree):
         """Host tree -> devices, committed onto the slice mesh (replicated)
@@ -330,6 +438,7 @@ class CollaborativeOptimizer:
         """Download the newest collaboration state (params+opt) from a peer
         (albert/run_trainer.py:124-128 on_train_begin semantics). Returns the
         local state unchanged if nobody shares yet."""
+        self._join_backup()
         result = self.averager.load_state_from_peers()
         if result is None:
             logger.info("no state providers found; starting from local state")
@@ -347,7 +456,6 @@ class CollaborativeOptimizer:
             params=self._device_put(params),
             opt_state=self._device_put(opt_state),
         )
-        self._last_good = ((params, opt_state), int(metadata.get("step", 0)))
         logger.info(f"loaded state from peers at global step {self.local_step}")
         return new_state
 
@@ -377,4 +485,5 @@ class CollaborativeOptimizer:
         return averaged is not None or group_size > 1
 
     def shutdown(self) -> None:
+        self._join_backup()
         self.averager.shutdown()

@@ -168,6 +168,9 @@ class CollaborativeOptimizerArguments:
     target_batch_size: int = 4096
     batch_size_lead: int = 0
     statistics_expiration: float = 600.0
+    # serve model+opt state to late joiners (p2p state transfer); turn off on
+    # solo/benchmark runs to keep the device↔host link free for dispatch
+    allow_state_sharing: bool = True
 
 
 @dataclass

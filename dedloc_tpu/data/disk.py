@@ -19,7 +19,7 @@ from dedloc_tpu.core.serialization import (
     deserialize_tree,
     serialize_tree,
 )
-from dedloc_tpu.data.mlm import SpecialTokens, mask_tokens
+from dedloc_tpu.data.mlm import SpecialTokens, mask_tokens, max_predictions_for
 
 COLUMNS = ("input_ids", "token_type_ids", "special_tokens_mask", "sop_labels")
 
@@ -91,6 +91,10 @@ def tokenized_dataset_batches(
     rng = np.random.default_rng(seed)
     tokens = SpecialTokens(vocab_size=cfg.vocab_size)
     seq_length = min(seq_length, cfg.max_position_embeddings)
+    # gathered label layout: the model projects to the vocab only at masked
+    # positions (~15%), not all seq_length of them — on ALBERT-large this is
+    # the difference between a 512x30k and an 81x30k logits tensor per row
+    max_predictions = max_predictions_for(seq_length)
     while True:
         for shard_path in rng.permutation(shards):
             with open(shard_path, "rb") as f:
@@ -111,4 +115,6 @@ def tokenized_dataset_batches(
                     "attention_mask": (ids != tokens.pad_id).astype(np.int32),
                     "sop_labels": cols["sop_labels"][idx].astype(np.int32),
                 }
-                yield mask_tokens(batch, rng, tokens)
+                yield mask_tokens(
+                    batch, rng, tokens, max_predictions=max_predictions
+                )

@@ -125,6 +125,14 @@ def pad_and_batch(
     return out
 
 
+def max_predictions_for(seq_length: int, mlm_probability: float = 0.15) -> int:
+    """Gathered-label capacity for a sequence length: the expected masked
+    count plus slack so sampling jitter never truncates labels. The single
+    source of truth — every producer of ``mlm_positions`` and every consumer
+    sizing the gathered head must agree on this width or shapes recompile."""
+    return int(seq_length * mlm_probability) + 4
+
+
 def mask_tokens(
     batch: Dict[str, np.ndarray],
     rng: np.random.Generator,

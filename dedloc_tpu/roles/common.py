@@ -10,7 +10,7 @@ import numpy as np
 
 from dedloc_tpu.collaborative.metrics import make_validators
 from dedloc_tpu.core.config import CollaborationArguments
-from dedloc_tpu.data.mlm import SpecialTokens, mask_tokens
+from dedloc_tpu.data.mlm import SpecialTokens, mask_tokens, max_predictions_for
 from dedloc_tpu.dht.dht import DHT
 from dedloc_tpu.models.albert import (
     AlbertConfig,
@@ -118,7 +118,7 @@ def synthetic_mlm_batches(
     rng = np.random.default_rng(seed)
     tokens = SpecialTokens(vocab_size=cfg.vocab_size)
     seq_length = min(seq_length, cfg.max_position_embeddings)
-    max_predictions = int(seq_length * 0.15) + 4
+    max_predictions = max_predictions_for(seq_length)
     while True:
         ids = rng.integers(
             tokens.num_reserved, cfg.vocab_size, (batch_size, seq_length)

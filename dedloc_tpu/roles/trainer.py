@@ -118,6 +118,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         expected_drift_rate=args.averager.expected_drift_rate,
         performance_ema_alpha=args.averager.performance_ema_alpha,
         client_mode=args.dht.client_mode,
+        allow_state_sharing=args.optimizer.allow_state_sharing,
         mesh=mesh,
         verbose=True,
     )
@@ -241,6 +242,14 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
                                     "allreduce"
                                 ).recent_mean
                                 * 1e3,
+                                # jit↔host seam breakdown (SURVEY §7b):
+                                # grads device_get / apply / async backup
+                                # list() snapshots atomically under the GIL —
+                                # the backup thread may insert its key mid-step
+                                "seam_ms": {
+                                    k: round(v, 2)
+                                    for k, v in list(opt.seam_ms.items())
+                                },
                             }
                         )
                         + "\n"
@@ -313,7 +322,7 @@ def _make_batches(
     if args.training.streaming_files:
         # sahajbert-style streaming mode (dataset_streaming.py capability):
         # weighted lazy mix + per-peer shuffle buffer + on-the-fly tokenize
-        from dedloc_tpu.data.mlm import SpecialTokens
+        from dedloc_tpu.data.mlm import SpecialTokens, max_predictions_for
         from dedloc_tpu.data.streaming import (
             split_sentences,
             streaming_mlm_batches,
@@ -350,7 +359,7 @@ def _make_batches(
             seq,
             seed,
             buffer_size=args.training.streaming_buffer_size,
-            max_predictions=int(seq * 0.15) + 4,
+            max_predictions=max_predictions_for(seq),
         )
     if not args.training.dataset_path:
         return synthetic_mlm_batches(

@@ -344,6 +344,46 @@ def test_round_failure_retries_then_applies_locally():
         dht.shutdown()
 
 
+def test_solo_fast_path_keeps_grads_on_device():
+    """After one record lifetime alone, a solo peer's global step must skip
+    the averager entirely (identity all-reduce): no matchmaking window, no
+    device_get of the gradient tree."""
+    dht = DHT(start=True, listen_host="127.0.0.1")
+    tx = lamb(0.05, weight_decay=0.0)
+    opt = CollaborativeOptimizer(
+        tx, dht, "solofast",
+        **_opt_kwargs(target_batch_size=16, metadata_expiration=0.2),
+    )
+
+    def _explode(*a, **k):
+        raise AssertionError("averager.step must not run on the solo path")
+
+    try:
+        time.sleep(0.5)  # pass the cold-start grace (metadata_expiration)
+        opt.averager.step = _explode
+        params = {"w": jnp.array([[0.5], [0.5]])}
+        state = TrainState.create(params, tx)
+        acc_fn = make_accumulate_step(_toy_loss)
+        batch = _make_problem(0)
+        grad_acc = zeros_like_grads(params)
+        n_acc = jnp.zeros([], jnp.int32)
+        stepped = False
+        deadline = time.time() + 30
+        while not stepped and time.time() < deadline:
+            grad_acc, n_acc, _ = acc_fn(
+                state.params, grad_acc, n_acc, batch, jax.random.PRNGKey(0)
+            )
+            state, grad_acc, n_acc, stepped = opt.step(
+                state, grad_acc, n_acc, samples=16
+            )
+        assert stepped and opt.local_step == 1
+        assert "grads_device_get" not in opt.seam_ms
+        assert "apply" in opt.seam_ms
+    finally:
+        opt.shutdown()
+        dht.shutdown()
+
+
 def test_batch_size_lead_starts_round_early():
     """batch_size_lead (CollaborativeOptimizerArguments capability): the
     round becomes ready `lead` samples before target so matchmaking latency
