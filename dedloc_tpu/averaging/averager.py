@@ -57,6 +57,9 @@ class DecentralizedAverager:
         advertised_host: Optional[str] = None,
         authorizer=None,  # TokenAuthorizerBase for gated runs (joiner side)
         authority_public_key: Optional[bytes] = None,  # leader-side gate
+        relay: Optional[str] = None,  # "host:port" of a public peer whose
+        # RelayService makes this client-mode peer reachable (circuit relay,
+        # p2p/circuit-relay.md); listening peers all serve as relays
     ):
         self.dht = dht
         self.prefix = prefix
@@ -84,12 +87,17 @@ class DecentralizedAverager:
         # build server+matchmaking+allreduce on the DHT loop
         def _setup(node):
             async def setup():
+                from dedloc_tpu.dht.protocol import RelayService
+
                 self.client = RPCClient(request_timeout=averaging_timeout)
                 if not client_mode:
                     self.server = RPCServer(*self._listen)
                     self.server.register("state.get", self._rpc_state_get)
                     await self.server.start()
                     self.endpoint = (self._advertised_host, self.server.port)
+                    # every public peer doubles as a circuit relay for
+                    # private peers (p2p/circuit-relay.md relay_enabled)
+                    self.relay_service = RelayService(self.server)
                 if authorizer is not None:
                     # gated runs bind peer identity to the token key so
                     # leaders/joiners can verify who signed what (see
@@ -101,6 +109,20 @@ class DecentralizedAverager:
                     )
                 else:
                     self.peer_id = node.node_id.to_bytes()
+                if client_mode and relay:
+                    # circuit relay: park an outbound connection at the
+                    # public peer; our RPC methods (mm.join, allreduce,
+                    # state.get is withheld — no state sharing in client
+                    # mode) become reachable at the virtual endpoint, so
+                    # this peer can lead groups and host spans like a
+                    # listening peer, with bytes riding the relay
+                    host, _, port = relay.rpartition(":")
+                    registry = RPCServer()  # handler registry; never listens
+                    self.server = registry
+                    self.client.reverse_handlers = registry._handlers
+                    self.endpoint = await self.client.register_with_relay(
+                        (host, int(port)), self.peer_id
+                    )
                 self.allreduce = GroupAllReduce(
                     self.client,
                     self.server,

@@ -96,6 +96,7 @@ class CollaborativeOptimizer:
         auxiliary: bool = False,
         allow_state_sharing: bool = True,
         mesh=None,
+        opt_state_sharding=None,  # ZeRO-1 moment layout (parallel.zero)
         verbose: bool = False,
         listen_host: str = "0.0.0.0",
         advertised_host: Optional[str] = None,
@@ -149,7 +150,10 @@ class CollaborativeOptimizer:
         self.local_step = 0
         self.local_samples_accumulated = 0
         self.mesh = mesh
-        self._apply_fn = make_apply_step(tx, mesh=mesh)
+        self.opt_state_sharding = opt_state_sharding
+        self._apply_fn = make_apply_step(
+            tx, mesh=mesh, opt_state_sharding=opt_state_sharding
+        )
         # post-update transform on the new state (e.g. SwAV prototype
         # re-normalization — NormalizePrototypesHook.on_update capability,
         # swav_hooks.py:55-92); runs once per GLOBAL step inside jit
@@ -199,7 +203,12 @@ class CollaborativeOptimizer:
         with self._lock:
             self.local_samples_accumulated += samples
             if self._ema_started:
-                self.performance_ema.update(samples)
+                # samples == 0 is a retry poll while a round assembles —
+                # neither progress nor throughput signal (and it must not
+                # touch the EMA clock: a resume() here would discard the
+                # elapsed interval and inflate samples/sec)
+                if samples > 0:
+                    self.performance_ema.update(samples)
             else:
                 # first call: start the clock only — measuring from resume()
                 # to now would seed the EMA with a near-zero interval and
@@ -425,13 +434,16 @@ class CollaborativeOptimizer:
             self._backup_thread.join()
             self._backup_thread = None
 
-    def _device_put(self, tree):
-        """Host tree -> devices, committed onto the slice mesh (replicated)
+    def _device_put(self, tree, sharding=None):
+        """Host tree -> devices, committed onto the slice mesh (replicated,
+        or a caller-supplied sharding pytree e.g. the ZeRO-1 moment layout)
         when one exists so accumulate doesn't re-broadcast per micro-batch."""
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            return jax.device_put(tree, NamedSharding(self.mesh, P()))
+            return jax.device_put(
+                tree, sharding or NamedSharding(self.mesh, P())
+            )
         return jax.device_put(tree)
 
     def load_state_from_peers(self, state: TrainState) -> TrainState:
@@ -454,7 +466,7 @@ class CollaborativeOptimizer:
         new_state = state.replace(
             step=jax.numpy.asarray(int(metadata.get("step", 0)), jax.numpy.int32),
             params=self._device_put(params),
-            opt_state=self._device_put(opt_state),
+            opt_state=self._device_put(opt_state, self.opt_state_sharding),
         )
         logger.info(f"loaded state from peers at global step {self.local_step}")
         return new_state

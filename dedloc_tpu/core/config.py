@@ -198,6 +198,14 @@ class TrainingArguments:
     # acts as ONE collaboration member (SURVEY.md §2.6 TPU-native mapping)
     mesh_devices: int = 1
     mesh_device_offset: int = 0  # carve disjoint device ranges (tests)
+    # sequence parallelism: factor of mesh_devices assigned to a "seq" mesh
+    # axis; with attention_impl="ring" the attention KV shards rotate around
+    # that axis (ring attention) so no device ever holds the full S×S scores
+    mesh_seq_devices: int = 1
+    # ZeRO-1: shard optimizer moments over the slice mesh's data axis
+    # (state memory / n_devices; params+grads stay replicated for the
+    # cross-slice averager). Requires mesh_devices > 1.
+    zero_sharding: bool = False
     gradient_accumulation_steps: int = 2
     learning_rate: float = 0.00176
     warmup_steps: int = 5000
@@ -232,6 +240,8 @@ class SwAVTrainingArguments:
     (:33-37,68,93-104) + sgd_collaborative.py:145-157."""
 
     model_size: str = "resnet50"  # tiny (CI fixture) | resnet50
+    image_folder: str = ""  # real images (flat or class-subdir layout);
+    # empty = synthetic fixture. Decoded+augmented via the SwAV SimCLR stack.
     max_local_steps: int = 0  # accumulation boundaries to run (0 = forever)
     per_device_batch_size: int = 8
     gradient_accumulation_steps: int = 1

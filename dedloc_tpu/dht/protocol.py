@@ -2,9 +2,19 @@
 
 This is the in-tree replacement for the reference's transport dependencies
 (libp2p daemon + gRPC, SURVEY.md §2.7): length-prefixed msgpack frames over
-TCP with a small request/response RPC layer. NAT traversal and relays are
-descoped for datacenter TPU fleets, but the seam is this module — a future
-transport only needs to provide ``call`` and ``serve``.
+TCP with a small request/response RPC layer.
+
+Circuit relay (the libp2p relay capability, p2p/circuit-relay.md:15-68): a
+peer that cannot listen publicly opens an OUTBOUND connection to a public
+peer's ``RelayService`` and registers; the connection then becomes
+bidirectional — relayed requests arrive on it as frames with a ``method``
+field and are dispatched against the client's ``reverse_handlers``. Anyone
+can then reach the private peer at the virtual endpoint
+``("relay:<host>:<port>:<peer_hex>", 0)``: ``RPCClient.call`` recognizes the
+form and wraps the call in a ``relay.call`` to the public peer, which pipes
+it down the registered connection and relays the reply back. NAT hole
+punching stays descoped (datacenter fleets); the relay covers the
+private↔private case end-to-end.
 """
 from __future__ import annotations
 
@@ -40,6 +50,20 @@ def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
 Handler = Callable[[Endpoint, Dict[str, Any]], Awaitable[Any]]
 
 
+def relay_endpoint(relay: Endpoint, peer_id: bytes) -> Endpoint:
+    """Virtual endpoint for a peer reachable only via ``relay``."""
+    return (f"relay:{relay[0]}:{relay[1]}:{peer_id.hex()}", 0)
+
+
+def parse_relay_endpoint(endpoint) -> Optional[Tuple[Endpoint, str]]:
+    """((relay_host, relay_port), peer_hex) if ``endpoint`` is relayed."""
+    host = endpoint[0]
+    if not (isinstance(host, str) and host.startswith("relay:")):
+        return None
+    _, rh, rp, peer_hex = host.split(":", 3)
+    return (rh, int(rp)), peer_hex
+
+
 class RPCServer:
     """Serves named RPC methods; one task per connection, many requests per
     connection (pipelined)."""
@@ -50,6 +74,9 @@ class RPCServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self._writers: set = set()
         self.port: Optional[int] = None
+        # reply frames (no "method") arriving on inbound connections belong
+        # to the RelayService, which forwarded a request down that connection
+        self.reply_router: Optional[Callable[[Dict[str, Any]], None]] = None
 
     def register(self, method: str, handler: Handler) -> None:
         self._handlers[method] = handler
@@ -80,6 +107,10 @@ class RPCServer:
                     msg = await read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
                     return
+                if msg.get("method") is None and self.reply_router is not None:
+                    # reply to a relayed request we piped down this connection
+                    self.reply_router(msg)
+                    continue
                 asyncio.ensure_future(self._dispatch(peer, msg, writer))
         finally:
             self._writers.discard(writer)
@@ -92,7 +123,12 @@ class RPCServer:
         try:
             if handler is None:
                 raise KeyError(f"unknown method {method!r}")
-            result = await handler(tuple(peer[:2]), msg.get("args") or {})
+            if getattr(handler, "rpc_wants_writer", False):
+                result = await handler(
+                    tuple(peer[:2]), msg.get("args") or {}, writer
+                )
+            else:
+                result = await handler(tuple(peer[:2]), msg.get("args") or {})
             reply = {"id": req_id, "ok": True, "result": result}
         except Exception as e:  # noqa: BLE001 — RPC boundary
             logger.debug(f"rpc {method} failed: {e!r}")
@@ -114,6 +150,10 @@ class RPCClient:
         self._readers: Dict[Endpoint, asyncio.Task] = {}
         self._next_id = 0
         self._conn_locks: Dict[Endpoint, asyncio.Lock] = {}
+        # circuit relay: requests relayed to THIS (otherwise unreachable)
+        # peer arrive on its outbound relay connection and dispatch here —
+        # point this at an RPCServer's handler dict to expose its methods
+        self.reverse_handlers: Dict[str, Handler] = {}
 
     async def _connect(self, endpoint: Endpoint):
         lock = self._conn_locks.setdefault(endpoint, asyncio.Lock())
@@ -134,6 +174,11 @@ class RPCClient:
         try:
             while True:
                 msg = await read_frame(reader)
+                if msg.get("method") is not None:
+                    # relayed request piped to us down our own outbound
+                    # connection (circuit relay): serve it and reply in-band
+                    asyncio.ensure_future(self._dispatch_reverse(endpoint, msg))
+                    continue
                 fut = self._pending.get(endpoint, {}).pop(msg.get("id"), None)
                 if fut is not None and not fut.done():
                     fut.set_result(msg)
@@ -141,6 +186,34 @@ class RPCClient:
             pass
         finally:
             self._drop(endpoint, ConnectionResetError("connection lost"))
+
+    async def _dispatch_reverse(self, endpoint: Endpoint, msg) -> None:
+        handler = self.reverse_handlers.get(msg.get("method"))
+        try:
+            if handler is None:
+                raise KeyError(f"unknown relayed method {msg.get('method')!r}")
+            result = await handler(endpoint, msg.get("args") or {})
+            reply = {"id": msg.get("id"), "ok": True, "result": result}
+        except Exception as e:  # noqa: BLE001 — RPC boundary
+            logger.debug(f"relayed rpc {msg.get('method')} failed: {e!r}")
+            reply = {"id": msg.get("id"), "ok": False, "error": repr(e)}
+        conn = self._conns.get(endpoint)
+        if conn is None:
+            return
+        try:
+            write_frame(conn[1], reply)
+            await conn[1].drain()
+        except (ConnectionResetError, RuntimeError, BrokenPipeError):
+            pass
+
+    async def register_with_relay(
+        self, relay: Endpoint, peer_id: bytes
+    ) -> Endpoint:
+        """Park this client's connection at a public peer's RelayService and
+        return the virtual endpoint others can reach us at. The pooled
+        connection stays open; ``reverse_handlers`` serve what arrives."""
+        await self.call(relay, "relay.register", {"peer_id": peer_id.hex()})
+        return relay_endpoint(relay, peer_id)
 
     def _drop(self, endpoint: Endpoint, exc: Exception) -> None:
         conn = self._conns.pop(endpoint, None)
@@ -160,7 +233,26 @@ class RPCClient:
         args: Optional[Dict[str, Any]] = None,
         timeout: Optional[float] = None,
     ) -> Any:
-        """Invoke a remote method; raises on transport error / remote error."""
+        """Invoke a remote method; raises on transport error / remote error.
+
+        A ``relay:`` endpoint is resolved by wrapping the call in a
+        ``relay.call`` to the public peer that hosts the target's
+        registration (circuit relay)."""
+        relayed = parse_relay_endpoint(endpoint)
+        if relayed is not None:
+            relay, peer_hex = relayed
+            inner_timeout = timeout or self.request_timeout
+            return await self.call(
+                relay,
+                "relay.call",
+                {
+                    "to": peer_hex,
+                    "method": method,
+                    "args": args or {},
+                    "timeout": inner_timeout,
+                },
+                timeout=inner_timeout + 5.0,
+            )
         endpoint = (endpoint[0], int(endpoint[1]))
         _, writer = await self._connect(endpoint)
         self._next_id += 1
@@ -187,3 +279,57 @@ class RPCClient:
 
 class RPCError(Exception):
     pass
+
+
+class RelayService:
+    """Attachable circuit-relay for a public RPCServer
+    (p2p/circuit-relay.md:15-68 capability: ``relay_enabled`` public node).
+
+    Private peers park an outbound connection via ``relay.register``;
+    ``relay.call`` pipes a request down that connection and relays the reply
+    back. The relay is transport-only: it never inspects payloads and takes
+    no part in the rounds it carries.
+    """
+
+    def __init__(self, server: RPCServer, call_timeout: float = 60.0):
+        self.call_timeout = call_timeout
+        self._registered: Dict[str, asyncio.StreamWriter] = {}
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._rpc_register.__func__.rpc_wants_writer = True
+        server.register("relay.register", self._rpc_register)
+        server.register("relay.call", self._rpc_call)
+        server.reply_router = self._route_reply
+
+    async def _rpc_register(self, peer: Endpoint, args, writer) -> dict:
+        self._registered[args["peer_id"]] = writer
+        return {"registered": True}
+
+    def _route_reply(self, msg) -> None:
+        fut = self._pending.pop(msg.get("id"), None)
+        if fut is not None and not fut.done():
+            fut.set_result(msg)
+
+    async def _rpc_call(self, peer: Endpoint, args) -> Any:
+        writer = self._registered.get(args["to"])
+        if writer is None or writer.is_closing():
+            self._registered.pop(args["to"], None)
+            raise KeyError(f"no relayed peer {args['to']!r} registered here")
+        self._next_id += 1
+        rid = self._next_id
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            write_frame(
+                writer,
+                {"id": rid, "method": args["method"], "args": args.get("args") or {}},
+            )
+            await writer.drain()
+            reply = await asyncio.wait_for(
+                fut, timeout=float(args.get("timeout") or self.call_timeout)
+            )
+        finally:
+            self._pending.pop(rid, None)
+        if not reply.get("ok"):
+            raise RPCError(reply.get("error", "unknown relayed error"))
+        return reply.get("result")

@@ -50,11 +50,18 @@ class AlbertConfig:
     # MXU ops when HBM allows)
     remat_policy: str = "nothing"
     # "dense" (materialized S² scores), "blockwise" (online-softmax over KV
-    # blocks via lax.scan, O(S·block) memory — the long-context path), or
+    # blocks via lax.scan, O(S·block) memory — the long-context path),
     # "flash" (the same math as ONE fused Pallas kernel with a custom-VJP
-    # backward: scores never leave VMEM; interpret-mode off TPU). All exact.
+    # backward: scores never leave VMEM; interpret-mode off TPU), or "ring"
+    # (sequence-parallel exact attention: KV shards rotate around the mesh's
+    # ``ring_axis`` via ppermute — requires ``ring_mesh``). All exact.
     attention_impl: str = "dense"
     attention_block_size: int = 512
+    # sequence-parallel context for attention_impl="ring": the mesh whose
+    # ``ring_axis`` the sequence dimension is sharded over (set by the
+    # trainer when --training.mesh_seq_devices > 1)
+    ring_mesh: Any = None
+    ring_axis: str = "seq"
 
     @staticmethod
     def large(**overrides) -> "AlbertConfig":
@@ -105,7 +112,7 @@ class AlbertSelfAttention(nn.Module):
         v = split_heads(_dense(cfg.hidden_size, cfg, "value")(hidden))
 
         if (
-            cfg.attention_impl in ("flash", "blockwise")
+            cfg.attention_impl in ("flash", "blockwise", "ring")
             and cfg.attention_dropout_prob > 0.0
             and not deterministic
         ):
@@ -127,6 +134,23 @@ class AlbertSelfAttention(nn.Module):
                 q, k, v, kv_bias,
                 block_q=cfg.attention_block_size,
                 block_k=cfg.attention_block_size,
+            ).reshape(B, S, H)
+        elif cfg.attention_impl == "ring":
+            # sequence parallelism: S is sharded over ring_mesh's ring_axis;
+            # each device keeps its resident queries and rotates KV shards
+            # around the ring (ppermute over ICI) — exact, never materializes
+            # the S×S score matrix on any one device
+            from dedloc_tpu.parallel.ring_attention import ring_attention
+
+            if cfg.ring_mesh is None:
+                raise ValueError(
+                    "attention_impl='ring' needs ring_mesh (a Mesh with a "
+                    f"{cfg.ring_axis!r} axis); the trainer sets it when "
+                    "--training.mesh_seq_devices > 1"
+                )
+            kv_bias = attn_bias[:, 0, 0, :]  # additive [B, S_kv]
+            ctx = ring_attention(
+                q, k, v, kv_bias, mesh=cfg.ring_mesh, axis=cfg.ring_axis
             ).reshape(B, S, H)
         elif cfg.attention_impl == "blockwise":
             # long-context path: exact online-softmax over KV blocks — never

@@ -147,8 +147,12 @@ def ring_attention(
         acc, row_max, row_sum = carry[:3]
         return (acc / row_sum[..., None]).astype(q_l.dtype)
 
-    qkv_spec = P(None, axis, None, None)
-    bias_spec = P(None, axis)
+    # the batch dim rides the mesh's data axis when one exists (dp x sp
+    # composition: the trainer shards batches P("data", "seq")); a pure-sp
+    # mesh replicates B
+    b_axis = "data" if "data" in dict(mesh.shape) else None
+    qkv_spec = P(b_axis, axis, None, None)
+    bias_spec = P(b_axis, axis)
     if bias is None:
         fn = shard_map(
             lambda a, b_, c: local(a, b_, c, None),

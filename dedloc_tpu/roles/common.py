@@ -36,6 +36,7 @@ def build_model(
     remat_policy: str = "",
     attention_impl: str = "",
     vocab_size: int = 0,
+    ring_mesh=None,
 ) -> Tuple[AlbertConfig, AlbertForPreTraining]:
     overrides = {}
     if remat_policy:
@@ -44,6 +45,8 @@ def build_model(
         overrides["attention_impl"] = attention_impl
     if vocab_size:
         overrides["vocab_size"] = vocab_size
+    if ring_mesh is not None:
+        overrides["ring_mesh"] = ring_mesh
     make = AlbertConfig.tiny if model_size == "tiny" else AlbertConfig.large
     cfg = make(**overrides)
     return cfg, AlbertForPreTraining(cfg)

@@ -37,6 +37,11 @@ class CoordinatorExtraArguments:
     save_checkpoint_step_interval: int = 5
     upload_interval: float = 0.0  # seconds; 0 disables state pulls
     metrics_log_path: str = "coordinator_metrics.jsonl"
+    # hub publication (run_first_peer.py:123-147 capability): a git working
+    # tree (optionally pushing to hub_git_remote) or a directory mirror
+    hub_git_dir: str = ""
+    hub_git_remote: str = ""
+    hub_mirror_dir: str = ""
 
 
 def run_coordinator(
@@ -50,6 +55,12 @@ def run_coordinator(
     loop for tests (0 = run forever)."""
     force_cpu_if_requested()
     extra = extra or CoordinatorExtraArguments()
+    if upload_fn is None:
+        from dedloc_tpu.utils.hub import build_upload_fn
+
+        upload_fn = build_upload_fn(
+            extra.hub_git_dir, extra.hub_git_remote, extra.hub_mirror_dir
+        )
     dht, _public_key = build_dht(args)
     logger.info(f"coordinator DHT root listening on {dht.port}")
 
@@ -117,7 +128,13 @@ def _pull_and_save(args, averager, step, upload_fn) -> None:
     )
     logger.info(f"saved collaboration checkpoint {path}")
     if upload_fn is not None:
-        upload_fn(path, step)
+        try:
+            upload_fn(path, step)
+        except Exception as e:  # noqa: BLE001 — a hub blip must not kill the
+            # coordinator: metrics aggregation and the next upload attempt
+            # matter more than this one push (reference behavior: the git
+            # push runs in a fire-and-forget thread, run_first_peer.py:139)
+            logger.warning(f"hub upload failed for step {step}: {e}")
 
 
 def _maybe_wandb(args: CollaborationArguments):

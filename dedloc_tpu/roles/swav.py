@@ -27,7 +27,11 @@ from dedloc_tpu.collaborative.optimizer import CollaborativeOptimizer
 from dedloc_tpu.core.config import SwAVCollaborationArguments, parse_config
 from dedloc_tpu.core.hooks import default_hooks
 from dedloc_tpu.core.trainer import Trainer
-from dedloc_tpu.data.multicrop import MultiCropSpec, synthetic_multicrop_batches
+from dedloc_tpu.data.multicrop import (
+    MultiCropSpec,
+    image_folder_multicrop_batches,
+    synthetic_multicrop_batches,
+)
 from dedloc_tpu.models.swav import (
     SwAVConfig,
     SwAVModel,
@@ -132,9 +136,14 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
     )
     grad_acc = zeros_like_grads(state.params)
     n_acc = jnp.zeros([], jnp.int32)
-    batches = synthetic_multicrop_batches(
-        spec, slice_batch, seed=t.seed
-    )
+    if t.image_folder:
+        # real JPEGs through the full SSL augmentation stack
+        # (ImgPilToMultiCrop + flip + color distortion + blur + normalize)
+        batches = image_folder_multicrop_batches(
+            t.image_folder, spec, slice_batch, seed=t.seed
+        )
+    else:
+        batches = synthetic_multicrop_batches(spec, slice_batch, seed=t.seed)
     samples = slice_batch * t.gradient_accumulation_steps
 
     # mutable local (non-collaborative) state, closed over by the step fn

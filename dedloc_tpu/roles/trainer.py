@@ -47,28 +47,49 @@ logger = get_logger(__name__)
 
 def run_trainer(args: CollaborationArguments) -> TrainState:
     force_cpu_if_requested()
+    # slice-as-one-peer: with mesh_devices > 1 this process drives a
+    # data-parallel mesh; the micro-batch grad mean lowers to ICI psums and
+    # the collaboration sees the whole slice as a single member. A
+    # mesh_seq_devices factor carves a "seq" axis out of the slice for
+    # sequence parallelism (ring attention).
+    mesh = None
+    if args.training.mesh_devices > 1:
+        from dedloc_tpu.parallel.mesh import make_mesh, put_batch
+
+        sp = max(1, args.training.mesh_seq_devices)
+        if args.training.mesh_devices % sp:
+            raise ValueError(
+                f"mesh_seq_devices ({sp}) must divide mesh_devices "
+                f"({args.training.mesh_devices})"
+            )
+        mesh = make_mesh(
+            args.training.mesh_devices,
+            axis_names=("data", "seq") if sp > 1 else ("data",),
+            shape=(args.training.mesh_devices // sp, sp) if sp > 1 else None,
+            device_offset=args.training.mesh_device_offset,
+        )
+        logger.info(f"slice mesh: {mesh.shape}")
+    elif args.training.mesh_seq_devices > 1:
+        raise ValueError("mesh_seq_devices > 1 requires mesh_devices > 1")
+    if args.training.attention_impl == "ring" and (
+        mesh is None or "seq" not in mesh.axis_names
+    ):
+        # fail here with the cause, not deep inside the first jitted trace
+        raise ValueError(
+            "attention_impl='ring' needs a sequence-parallel mesh axis: set "
+            "--training.mesh_seq_devices > 1 (and mesh_devices divisible by it)"
+        )
+
     cfg, model = build_model(
         args.training.model_size,
         args.training.remat_policy,
         args.training.attention_impl,
         args.training.vocab_size,
+        ring_mesh=mesh if args.training.attention_impl == "ring" else None,
     )
     tx = build_optimizer(args)
     dht, public_key = build_dht(args)
     logger.info(f"trainer DHT listening on {dht.port}")
-
-    # slice-as-one-peer: with mesh_devices > 1 this process drives a
-    # data-parallel mesh; the micro-batch grad mean lowers to ICI psums and
-    # the collaboration sees the whole slice as a single member
-    mesh = None
-    if args.training.mesh_devices > 1:
-        from dedloc_tpu.parallel.mesh import make_mesh, put_batch
-
-        mesh = make_mesh(
-            args.training.mesh_devices,
-            device_offset=args.training.mesh_device_offset,
-        )
-        logger.info(f"slice mesh: {mesh.shape}")
 
     rng = jax.random.PRNGKey(args.training.seed)
     seq = min(args.training.seq_length, cfg.max_position_embeddings)
@@ -95,6 +116,14 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         )
         logger.info(f"resumed from local checkpoint at step {step}")
 
+    opt_sharding = None
+    if mesh is not None and args.training.zero_sharding:
+        # ZeRO-1: LAMB moments shard over the slice's data axis; GSPMD
+        # inserts the gathers the elementwise update needs (parallel/zero.py)
+        from dedloc_tpu.parallel.zero import opt_state_shardings
+
+        opt_sharding = opt_state_shardings(state.opt_state, mesh)
+
     opt = CollaborativeOptimizer(
         tx,
         dht,
@@ -120,6 +149,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         client_mode=args.dht.client_mode,
         allow_state_sharing=args.optimizer.allow_state_sharing,
         mesh=mesh,
+        opt_state_sharding=opt_sharding,
         verbose=True,
     )
     # catch up with the collaboration before training (:124-128)
@@ -130,7 +160,14 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         # the default device on every micro-batch until the first global step
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        state = jax.device_put(state, NamedSharding(mesh, P()))
+        repl = NamedSharding(mesh, P())
+        state = state.replace(
+            step=jax.device_put(state.step, repl),
+            params=jax.device_put(state.params, repl),
+            opt_state=jax.device_put(
+                state.opt_state, opt_sharding or repl
+            ),
+        )
 
     loss_fn = build_loss_fn(model)
     accumulate = make_accumulate_step(loss_fn, mesh=mesh)

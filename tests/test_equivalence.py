@@ -1,0 +1,178 @@
+"""The DeDLOC headline claim, end to end on REAL text (SURVEY.md §0,
+VERDICT r1 item 1): N collaborative peers with asynchronous membership
+emulate ONE large-batch synchronous run.
+
+The corpus is real English prose harvested from this package's own
+docstrings (zero-egress, data/corpus.py), pushed through the full pipeline:
+tokenizer training -> prepare (segment-pair MLM+SOP instances) -> shard
+cache -> masked batches. Two collaborative peers then split the exact
+micro-batch stream a single-peer large-batch run consumes; after K global
+steps their parameters must match the single-peer run's to numerical
+tolerance — not "similar loss", the SAME trajectory.
+"""
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dedloc_tpu.collaborative import CollaborativeOptimizer
+from dedloc_tpu.dht import DHT
+from dedloc_tpu.models.albert import AlbertConfig, AlbertForPreTraining
+from dedloc_tpu.optim import lamb
+from dedloc_tpu.parallel.train_step import (
+    TrainState,
+    make_accumulate_step,
+    make_apply_step,
+    zeros_like_grads,
+)
+from dedloc_tpu.roles.common import build_loss_fn
+
+
+@pytest.fixture(scope="module")
+def real_text_dataset(tmp_path_factory):
+    """Docstring prose -> trained tokenizer -> tokenized MLM+SOP shards."""
+    import dedloc_tpu
+    from dedloc_tpu.data.corpus import harvest
+    from dedloc_tpu.data.prepare import PrepareArguments, run_prepare
+    from dedloc_tpu.data.tokenizer import FastTokenizer, train_unigram_tokenizer
+
+    tmp = tmp_path_factory.mktemp("realtext")
+    docs = list(
+        harvest(
+            roots=[os.path.dirname(dedloc_tpu.__file__)],
+            min_words=30,
+            max_docs=300,
+        )
+    )
+    assert len(docs) >= 20, "package docstrings must yield real prose"
+    corpus = tmp / "docs.txt"
+    corpus.write_text("\n".join(docs), encoding="utf-8")
+
+    tok = train_unigram_tokenizer(docs, vocab_size=512)
+    tok_path = tmp / "tokenizer.json"
+    FastTokenizer(tok).save(str(tok_path))
+
+    out = tmp / "tokenized"
+    total = run_prepare(
+        PrepareArguments(
+            input=[str(corpus)],
+            tokenizer_path=str(tok_path),
+            output_dir=str(out),
+            max_seq_length=64,
+            examples_per_shard=512,
+        )
+    )
+    assert total >= 32, f"too few instances from real prose: {total}"
+    return str(out)
+
+
+def test_two_peer_collaboration_matches_single_large_batch(real_text_dataset):
+    from dedloc_tpu.data.disk import tokenized_dataset_batches
+
+    cfg = AlbertConfig.tiny(dtype=jnp.float32)  # fp32: exactness, not speed
+    model = AlbertForPreTraining(cfg)
+    loss_fn = build_loss_fn(model)
+    tx = lamb(5e-3, weight_decay=0.01)
+
+    B, K = 4, 4  # micro-batch size, global steps
+    stream = tokenized_dataset_batches(real_text_dataset, cfg, B, 64, seed=0)
+    micro = [
+        {k: jnp.asarray(v) for k, v in next(stream).items()
+         if k != "special_tokens_mask"}
+        for _ in range(2 * K)
+    ]
+
+    init_params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((B, 64), jnp.int32)
+    )["params"]
+    accumulate = make_accumulate_step(loss_fn)
+    rngs = [jax.random.PRNGKey(100 + i) for i in range(2 * K)]
+
+    # ---- single peer, large batch: both micro-batches every step
+    apply_fn = make_apply_step(tx)
+    single = TrainState.create(jax.tree.map(jnp.copy, init_params), tx)
+    for k in range(K):
+        grad_acc = zeros_like_grads(single.params)
+        n_acc = jnp.zeros([], jnp.int32)
+        for j in (2 * k, 2 * k + 1):
+            grad_acc, n_acc, _ = accumulate(
+                single.params, grad_acc, n_acc, micro[j], rngs[j]
+            )
+        mean = jax.tree.map(lambda g: g / 2, grad_acc)
+        single = apply_fn(single, mean)
+    single_params = jax.device_get(single.params)
+
+    # ---- two collaborative peers: the SAME stream, split round-robin
+    first_dht = DHT(start=True, listen_host="127.0.0.1")
+    second_dht = DHT(start=True, listen_host="127.0.0.1",
+                     initial_peers=[first_dht.get_visible_address()])
+    results, errors = {}, []
+
+    def peer(idx, dht):
+        try:
+            opt = CollaborativeOptimizer(
+                tx, dht, "equiv",
+                target_batch_size=2 * B,
+                compression="none",  # exactness on the wire
+                averaging_expiration=1.5,
+                averaging_timeout=20.0,
+                min_refresh_period=0.1,
+                default_refresh_period=0.3,
+                listen_host="127.0.0.1",
+            )
+            state = TrainState.create(jax.tree.map(jnp.copy, init_params), tx)
+            grad_acc = zeros_like_grads(state.params)
+            n_acc = jnp.zeros([], jnp.int32)
+            deadline = time.time() + 120
+            k = 0
+            while k < K and time.time() < deadline:
+                j = 2 * k + idx  # peer 0 takes even micro-batches, peer 1 odd
+                grad_acc, n_acc, _ = accumulate(
+                    state.params, grad_acc, n_acc, micro[j], rngs[j]
+                )
+                stepped = False
+                first = True
+                while not stepped and time.time() < deadline:
+                    # report the B fresh samples exactly once; retries while
+                    # the round assembles must not inflate the progress count
+                    state, grad_acc, n_acc, stepped = opt.step(
+                        state, grad_acc, n_acc, samples=B if first else 0
+                    )
+                    first = False
+                    if not stepped and opt.local_step > k:
+                        break  # caught up externally (shouldn't happen here)
+                    if not stepped:
+                        time.sleep(0.05)
+                k = opt.local_step
+            results[idx] = (jax.device_get(state.params), opt.local_step)
+            opt.shutdown()
+        except Exception as e:  # noqa: BLE001
+            errors.append((idx, e))
+
+    threads = [
+        threading.Thread(target=peer, args=(i, d), daemon=True)
+        for i, d in ((0, first_dht), (1, second_dht))
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=150)
+        assert not errors, errors
+        assert set(results) == {0, 1}
+        for idx in (0, 1):
+            params, steps = results[idx]
+            assert steps == K, f"peer {idx} finished only {steps}/{K} steps"
+            flat_a = jax.tree_util.tree_leaves(params)
+            flat_b = jax.tree_util.tree_leaves(single_params)
+            for a, b in zip(flat_a, flat_b):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5
+                )
+    finally:
+        second_dht.shutdown()
+        first_dht.shutdown()

@@ -1,0 +1,146 @@
+"""Checkpoint hub publication (run_first_peer.py:123-147 capability): git
+uploader against a local bare remote, directory mirror, coordinator wiring."""
+import os
+import subprocess
+
+import numpy as np
+
+from dedloc_tpu.utils.checkpoint import save_checkpoint
+from dedloc_tpu.utils.hub import (
+    build_upload_fn,
+    directory_mirror_uploader,
+    git_hub_uploader,
+)
+
+
+def _ckpt(tmp_path, step, value):
+    return save_checkpoint(
+        str(tmp_path / "ckpts"), step,
+        {"w": np.full((4,), value, np.float32)},
+        metadata={"step": step}, save_total_limit=None,
+    )
+
+
+def test_git_uploader_pushes_to_bare_remote(tmp_path):
+    remote = str(tmp_path / "hub.git")
+    subprocess.run(
+        ["git", "init", "--bare", "--initial-branch", "main", remote],
+        check=True, capture_output=True,
+    )
+    upload = git_hub_uploader(str(tmp_path / "work"), remote)
+
+    upload(_ckpt(tmp_path, 5, 1.0), 5)
+    upload(_ckpt(tmp_path, 10, 2.0), 10)
+    # identical re-publish is a no-op commit-wise
+    upload(_ckpt(tmp_path, 10, 2.0), 10)
+
+    log = subprocess.run(
+        ["git", "-C", remote, "log", "--format=%s", "main"],
+        check=True, capture_output=True, text=True,
+    ).stdout.strip().splitlines()
+    assert log == [
+        "checkpoint at collaboration step 10",
+        "checkpoint at collaboration step 5",
+    ]
+    files = subprocess.run(
+        ["git", "-C", remote, "ls-tree", "--name-only", "main"],
+        check=True, capture_output=True, text=True,
+    ).stdout.split()
+    assert "state.bin" in files and "step.txt" in files
+
+
+def test_git_uploader_without_remote_commits_locally(tmp_path):
+    work = str(tmp_path / "work")
+    upload = git_hub_uploader(work)
+    upload(_ckpt(tmp_path, 1, 3.0), 1)
+    log = subprocess.run(
+        ["git", "-C", work, "log", "--format=%s"],
+        check=True, capture_output=True, text=True,
+    ).stdout.strip()
+    assert "step 1" in log
+
+
+def test_directory_mirror_uploader(tmp_path):
+    dest = str(tmp_path / "mirror")
+    upload = directory_mirror_uploader(dest)
+    upload(_ckpt(tmp_path, 7, 1.5), 7)
+    assert os.path.exists(os.path.join(dest, "checkpoint-7", "state.bin"))
+    assert open(os.path.join(dest, "latest")).read() == "7"
+
+
+def test_build_upload_fn_resolution(tmp_path):
+    assert build_upload_fn() is None
+    assert build_upload_fn(hub_mirror_dir=str(tmp_path / "m")) is not None
+    assert build_upload_fn(hub_git_dir=str(tmp_path / "g")) is not None
+
+
+def test_coordinator_publishes_to_hub(tmp_path):
+    """End-to-end: a sharing trainer peer + coordinator loop with
+    upload_interval -> checkpoint lands in the hub mirror."""
+    from dedloc_tpu.core.config import CollaborationArguments, parse_config
+    from dedloc_tpu.roles.common import build_dht
+    from dedloc_tpu.roles.coordinator import (
+        CoordinatorExtraArguments,
+        run_coordinator,
+    )
+    from dedloc_tpu.roles.trainer import run_trainer
+    import threading
+
+    base = [
+        "--dht.listen_host", "127.0.0.1",
+        "--training.model_size", "tiny",
+        "--training.seq_length", "64",
+        "--training.per_device_batch_size", "2",
+        "--training.gradient_accumulation_steps", "2",
+        "--training.warmup_steps", "2",
+        "--training.total_steps", "50",
+        "--averager.averaging_expiration", "1.0",
+        "--averager.min_refresh_period", "0.1",
+        "--averager.default_refresh_period", "0.3",
+        "--optimizer.target_batch_size", "8",
+    ]
+    root_args = parse_config(
+        CollaborationArguments,
+        base + ["--training.output_dir", str(tmp_path / "coord")],
+    )
+    root_dht, _ = build_dht(root_args)
+    try:
+        addr = root_dht.get_visible_address()
+        trainer_args = parse_config(
+            CollaborationArguments,
+            base + [
+                "--dht.initial_peers", addr,
+                "--training.max_local_steps", "40",
+                "--training.save_steps", "0",
+                "--training.output_dir", str(tmp_path / "peer"),
+            ],
+        )
+        t = threading.Thread(target=run_trainer, args=(trainer_args,), daemon=True)
+        t.start()
+
+        mirror = str(tmp_path / "hub")
+        coord_args = parse_config(
+            CollaborationArguments,
+            base + [
+                "--dht.initial_peers", addr,
+                "--training.output_dir", str(tmp_path / "coord"),
+            ],
+        )
+        run_coordinator(
+            coord_args,
+            CoordinatorExtraArguments(
+                refresh_period=0.5,
+                upload_interval=0.1,
+                metrics_log_path=str(tmp_path / "metrics.jsonl"),
+                hub_mirror_dir=mirror,
+            ),
+            max_iterations=150,
+        )
+        t.join(timeout=60)
+        published = [
+            d for d in (os.listdir(mirror) if os.path.isdir(mirror) else [])
+            if d.startswith("checkpoint-")
+        ]
+        assert published, "coordinator never published a checkpoint to the hub"
+    finally:
+        root_dht.shutdown()

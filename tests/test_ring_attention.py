@@ -99,3 +99,36 @@ def test_blockwise_bf16_stable(rng):
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
     )
+
+
+def test_albert_ring_impl_matches_dense_model_level():
+    """attention_impl='ring' is a drop-in workload option: same params, same
+    logits as the dense model (sequence sharded over a 2-device seq axis)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from dedloc_tpu.models.albert import AlbertConfig, AlbertForPreTraining
+
+    devices = np.array(jax.devices()[:2]).reshape(1, 2)
+    mesh = Mesh(devices, ("data", "seq"))
+    dense_cfg = AlbertConfig.tiny(attention_impl="dense")
+    ring_cfg = AlbertConfig.tiny(attention_impl="ring", ring_mesh=mesh)
+    dense_model = AlbertForPreTraining(dense_cfg)
+    ring_model = AlbertForPreTraining(ring_cfg)
+
+    B, S = 2, dense_cfg.max_position_embeddings
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, dense_cfg.vocab_size, (B, S)),
+        jnp.int32,
+    )
+    params = dense_model.init(jax.random.PRNGKey(0), ids)["params"]
+    mlm_d, sop_d = dense_model.apply({"params": params}, ids)
+    mlm_r, sop_r = ring_model.apply({"params": params}, ids)
+    np.testing.assert_allclose(
+        np.asarray(mlm_d, np.float32), np.asarray(mlm_r, np.float32),
+        atol=5e-2, rtol=5e-2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sop_d, np.float32), np.asarray(sop_r, np.float32),
+        atol=5e-2, rtol=5e-2,
+    )
