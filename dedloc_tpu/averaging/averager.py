@@ -39,6 +39,21 @@ from dedloc_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
+def schema_fingerprint(tree: Dict[str, np.ndarray]) -> bytes:
+    """Order-independent hash of (name, shape, dtype) — the join-time
+    compatibility handshake: peers whose trees cannot all-reduce together
+    are refused by leaders instead of failing a span assert mid-round."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for name in sorted(tree):
+        arr = tree[name]
+        h.update(name.encode())
+        h.update(str(tuple(arr.shape)).encode())
+        h.update(str(arr.dtype).encode())
+    return h.digest()[:16]
+
+
 class DecentralizedAverager:
     def __init__(
         self,
@@ -117,11 +132,32 @@ class DecentralizedAverager:
                     # this peer can lead groups and host spans like a
                     # listening peer, with bytes riding the relay
                     host, _, port = relay.rpartition(":")
+                    relay_ep = (host, int(port))
                     registry = RPCServer()  # handler registry; never listens
                     self.server = registry
                     self.client.reverse_handlers = registry._handlers
                     self.endpoint = await self.client.register_with_relay(
-                        (host, int(port)), self.peer_id
+                        relay_ep, self.peer_id
+                    )
+
+                    async def keep_registered() -> None:
+                        # a dropped relay connection silently unregisters us
+                        # (the relay maps peer -> that connection's writer);
+                        # without re-registration every round where we lead
+                        # or host would fail for the rest of the run
+                        while True:
+                            await asyncio.sleep(5.0)
+                            if relay_ep not in self.client._conns:
+                                try:
+                                    await self.client.register_with_relay(
+                                        relay_ep, self.peer_id
+                                    )
+                                    logger.info("re-registered with relay")
+                                except Exception as e:  # noqa: BLE001
+                                    logger.debug(f"relay re-register: {e!r}")
+
+                    self._relay_keepalive = asyncio.ensure_future(
+                        keep_registered()
                     )
                 self.allreduce = GroupAllReduce(
                     self.client,
@@ -174,7 +210,9 @@ class DecentralizedAverager:
         self, tree: Dict[str, np.ndarray], weight: float, round_id: str
     ) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
         try:
-            group = await self.matchmaking.form_group(round_id)
+            group = await self.matchmaking.form_group(
+                round_id, schema=schema_fingerprint(tree)
+            )
         except MatchmakingFailed as e:
             logger.debug(f"matchmaking failed for {round_id}: {e}")
             return None, 1
@@ -302,6 +340,9 @@ class DecentralizedAverager:
     def shutdown(self) -> None:
         def _stop(node):
             async def stop():
+                keepalive = getattr(self, "_relay_keepalive", None)
+                if keepalive is not None:
+                    keepalive.cancel()
                 await self.client.close()
                 if self.server is not None:
                     await self.server.stop()

@@ -75,8 +75,12 @@ class RPCServer:
         self._writers: set = set()
         self.port: Optional[int] = None
         # reply frames (no "method") arriving on inbound connections belong
-        # to the RelayService, which forwarded a request down that connection
-        self.reply_router: Optional[Callable[[Dict[str, Any]], None]] = None
+        # to the RelayService, which forwarded a request down that
+        # connection; called with (msg, writer) so replies are only accepted
+        # from the connection the request was piped down
+        self.reply_router: Optional[
+            Callable[[Dict[str, Any], asyncio.StreamWriter], None]
+        ] = None
 
     def register(self, method: str, handler: Handler) -> None:
         self._handlers[method] = handler
@@ -108,8 +112,10 @@ class RPCServer:
                 except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
                     return
                 if msg.get("method") is None and self.reply_router is not None:
-                    # reply to a relayed request we piped down this connection
-                    self.reply_router(msg)
+                    # reply to a relayed request we piped down this
+                    # connection — the writer identifies WHICH connection,
+                    # so a stranger cannot complete someone else's call
+                    self.reply_router(msg, writer)
                     continue
                 asyncio.ensure_future(self._dispatch(peer, msg, writer))
         finally:
@@ -294,7 +300,11 @@ class RelayService:
     def __init__(self, server: RPCServer, call_timeout: float = 60.0):
         self.call_timeout = call_timeout
         self._registered: Dict[str, asyncio.StreamWriter] = {}
-        self._pending: Dict[int, asyncio.Future] = {}
+        # pending futures keyed by id, VALIDATED against the writer the
+        # request was forwarded on — a reply arriving on any other
+        # connection (i.e. from a different peer) is discarded, so a
+        # stranger cannot forge results into someone else's relayed call
+        self._pending: Dict[int, Tuple[asyncio.Future, asyncio.StreamWriter]] = {}
         self._next_id = 0
         self._rpc_register.__func__.rpc_wants_writer = True
         server.register("relay.register", self._rpc_register)
@@ -305,9 +315,18 @@ class RelayService:
         self._registered[args["peer_id"]] = writer
         return {"registered": True}
 
-    def _route_reply(self, msg) -> None:
-        fut = self._pending.pop(msg.get("id"), None)
-        if fut is not None and not fut.done():
+    def _route_reply(self, msg, writer) -> None:
+        entry = self._pending.get(msg.get("id"))
+        if entry is None:
+            return
+        fut, expected_writer = entry
+        if writer is not expected_writer:
+            logger.warning(
+                "discarding relayed reply arriving on the wrong connection"
+            )
+            return
+        self._pending.pop(msg.get("id"), None)
+        if not fut.done():
             fut.set_result(msg)
 
     async def _rpc_call(self, peer: Endpoint, args) -> Any:
@@ -318,7 +337,7 @@ class RelayService:
         self._next_id += 1
         rid = self._next_id
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._pending[rid] = fut
+        self._pending[rid] = (fut, writer)
         try:
             write_frame(
                 writer,

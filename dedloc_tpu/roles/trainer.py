@@ -116,6 +116,11 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         )
         logger.info(f"resumed from local checkpoint at step {step}")
 
+    if args.training.zero_sharding and mesh is None:
+        raise ValueError(
+            "--training.zero_sharding shards optimizer moments over a slice "
+            "mesh; set --training.mesh_devices > 1"
+        )
     opt_sharding = None
     if mesh is not None and args.training.zero_sharding:
         # ZeRO-1: LAMB moments shard over the slice's data axis; GSPMD

@@ -78,6 +78,14 @@ def git_hub_uploader(
             _git(work_dir, "init", "--initial-branch", branch)
             if remote_url:
                 _git(work_dir, "remote", "add", "origin", remote_url)
+                # a fresh work_dir against a hub with history (coordinator
+                # restart) must build on the remote tip, or every push is
+                # rejected as non-fast-forward forever
+                try:
+                    _git(work_dir, "fetch", "origin", branch)
+                    _git(work_dir, "checkout", "-B", branch, "FETCH_HEAD")
+                except RuntimeError:
+                    pass  # empty remote: first-ever publish
         _mirror_checkpoint(checkpoint_path, work_dir)
         with open(os.path.join(work_dir, "step.txt"), "w") as f:
             f.write(str(step))

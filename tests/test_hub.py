@@ -144,3 +144,24 @@ def test_coordinator_publishes_to_hub(tmp_path):
         assert published, "coordinator never published a checkpoint to the hub"
     finally:
         root_dht.shutdown()
+
+
+def test_git_uploader_survives_coordinator_restart(tmp_path):
+    """A fresh work_dir against a hub remote with history must fetch and
+    build on the remote tip — not fail every push as non-fast-forward."""
+    remote = str(tmp_path / "hub.git")
+    subprocess.run(
+        ["git", "init", "--bare", "--initial-branch", "main", remote],
+        check=True, capture_output=True,
+    )
+    git_hub_uploader(str(tmp_path / "work1"), remote)(_ckpt(tmp_path, 5, 1.0), 5)
+    # restart: new working dir, same remote
+    git_hub_uploader(str(tmp_path / "work2"), remote)(_ckpt(tmp_path, 9, 2.0), 9)
+    log = subprocess.run(
+        ["git", "-C", remote, "log", "--format=%s", "main"],
+        check=True, capture_output=True, text=True,
+    ).stdout.strip().splitlines()
+    assert log == [
+        "checkpoint at collaboration step 9",
+        "checkpoint at collaboration step 5",
+    ]
