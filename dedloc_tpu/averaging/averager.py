@@ -76,6 +76,14 @@ class DecentralizedAverager:
         # RelayService makes this client-mode peer reachable (circuit relay,
         # p2p/circuit-relay.md); listening peers all serve as relays
     ):
+        if relay and not client_mode:
+            # a listening peer IS a relay; accepting (and dropping) the flag
+            # would leave a NAT-ed operator who forgot client_mode with an
+            # unreachable advertised address and no signal why
+            raise ValueError(
+                "relay= is for client-mode peers (set client_mode=True); "
+                "listening peers serve as relays themselves"
+            )
         self.dht = dht
         self.prefix = prefix
         self.client_mode = client_mode

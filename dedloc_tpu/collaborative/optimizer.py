@@ -93,6 +93,7 @@ class CollaborativeOptimizer:
         expected_drift_rate: float = 0.2,
         performance_ema_alpha: float = 0.1,
         client_mode: bool = False,
+        relay: Optional[str] = None,  # circuit relay for client-mode peers
         auxiliary: bool = False,
         allow_state_sharing: bool = True,
         mesh=None,
@@ -130,6 +131,7 @@ class CollaborativeOptimizer:
             advertised_host=advertised_host,
             authorizer=authorizer,
             authority_public_key=authority_public_key,
+            relay=relay,
         )
         self.tracker = ProgressTracker(
             dht,

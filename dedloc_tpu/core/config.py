@@ -141,6 +141,9 @@ class DHTArguments:
     listen_host: str = "0.0.0.0"
     listen_port: int = 0  # 0 = ephemeral
     client_mode: bool = False  # outbound-only peer (albert/arguments.py:63-65)
+    # "host:port" of any public peer: a client-mode peer registers with its
+    # circuit relay and becomes able to lead groups / host spans through it
+    relay: str = ""
 
 
 @dataclass

@@ -125,6 +125,7 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
         metadata_expiration=args.averager.metadata_expiration,
         statistics_expiration=args.optimizer.statistics_expiration,
         client_mode=args.dht.client_mode,
+        relay=args.dht.relay or None,
         mesh=mesh,
         post_apply=make_prototype_post_apply(),
         verbose=True,

@@ -152,6 +152,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         expected_drift_rate=args.averager.expected_drift_rate,
         performance_ema_alpha=args.averager.performance_ema_alpha,
         client_mode=args.dht.client_mode,
+        relay=args.dht.relay or None,
         allow_state_sharing=args.optimizer.allow_state_sharing,
         mesh=mesh,
         opt_state_sharding=opt_sharding,

@@ -1133,3 +1133,58 @@ def test_schema_mismatch_rejected_at_join_time(rng):
     finally:
         a1.shutdown(); a2.shutdown()
         d2.shutdown(); root.shutdown()
+
+
+def test_gated_round_via_relay(rng):
+    """VERDICT r1 item 8, gated variant: two token-bearing client-mode peers
+    join a GATED round through a public peer's relay — mutual envelope auth
+    rides the relayed transport unchanged."""
+    from dedloc_tpu.averaging import DecentralizedAverager
+    from dedloc_tpu.core.auth import AllowlistAuthServer, AllowlistAuthorizer
+    from dedloc_tpu.dht import DHT
+
+    auth_server = AllowlistAuthServer({"alice": "pw", "bob": "pw"})
+    root = DHT(start=True, listen_host="127.0.0.1")
+    d1 = DHT(start=True, listen_host="127.0.0.1",
+             initial_peers=[root.get_visible_address()], client_mode=True)
+    d2 = DHT(start=True, listen_host="127.0.0.1",
+             initial_peers=[root.get_visible_address()], client_mode=True)
+    public = DecentralizedAverager(
+        root, "gr", averaging_expiration=1.0, averaging_timeout=15.0,
+        listen_host="127.0.0.1",
+    )
+    relay_addr = f"127.0.0.1:{public.server.port}"
+
+    def gated(dht, user):
+        return DecentralizedAverager(
+            dht, "gr", client_mode=True, relay=relay_addr,
+            averaging_expiration=1.0, averaging_timeout=15.0,
+            compression="none",
+            authorizer=AllowlistAuthorizer(
+                user, "pw", auth_server.issue_token,
+                auth_server.authority_public_key,
+            ),
+            authority_public_key=auth_server.authority_public_key,
+        )
+
+    a1, a2 = gated(d1, "alice"), gated(d2, "bob")
+    try:
+        out = {}
+
+        def run(idx, avg, v):
+            out[idx] = avg.step(
+                {"v": np.array(v, np.float32)}, weight=1.0, round_id="g"
+            )
+
+        th1 = threading.Thread(target=run, args=(1, a1, [2.0]), daemon=True)
+        th2 = threading.Thread(target=run, args=(2, a2, [4.0]), daemon=True)
+        th1.start(); th2.start()
+        th1.join(timeout=45); th2.join(timeout=45)
+        assert 1 in out and 2 in out, "gated relayed round never completed"
+        assert out[1][1] == 2 and out[2][1] == 2
+        np.testing.assert_allclose(out[1][0]["v"], 3.0, atol=1e-6)
+        np.testing.assert_allclose(out[2][0]["v"], 3.0, atol=1e-6)
+    finally:
+        a1.shutdown(); a2.shutdown(); public.shutdown()
+        for d in (d1, d2, root):
+            d.shutdown()
