@@ -140,6 +140,10 @@ class DHTArguments:
     initial_peers: List[str] = field(default_factory=list)  # "host:port" strings
     listen_host: str = "0.0.0.0"
     listen_port: int = 0  # 0 = ephemeral
+    # public address other peers should dial (the reference coordinator
+    # resolves its public IP the same way, run_first_peer.py:153-155);
+    # empty = loopback (single-host runs)
+    advertised_host: str = ""
     client_mode: bool = False  # outbound-only peer (albert/arguments.py:63-65)
     # "host:port" of any public peer: a client-mode peer registers with its
     # circuit relay and becomes able to lead groups / host spans through it

@@ -70,6 +70,7 @@ def run_aux(
         averaging_expiration=args.averager.averaging_expiration,
         averaging_timeout=args.averager.averaging_timeout,
         auxiliary=True,
+        advertised_host=args.dht.advertised_host or None,
         allow_state_sharing=False,
         verbose=True,
     )

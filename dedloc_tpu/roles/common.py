@@ -78,6 +78,7 @@ def build_dht(args: CollaborationArguments, client_mode: Optional[bool] = None):
         listen_port=args.dht.listen_port,
         client_mode=args.dht.client_mode if client_mode is None else client_mode,
         record_validators=validators,
+        advertised_host=args.dht.advertised_host or None,
     )
     return dht, public_key
 

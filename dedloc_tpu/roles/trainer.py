@@ -153,6 +153,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         performance_ema_alpha=args.averager.performance_ema_alpha,
         client_mode=args.dht.client_mode,
         relay=args.dht.relay or None,
+        advertised_host=args.dht.advertised_host or None,
         allow_state_sharing=args.optimizer.allow_state_sharing,
         mesh=mesh,
         opt_state_sharding=opt_sharding,

@@ -116,10 +116,21 @@ def test_coordinator_aggregates_published_metrics(tmp_path):
 
 
 def test_two_trainer_roles_collaborate(tmp_path):
-    """Two trainer-role peers bootstrap off one DHT node and both advance the
-    global step — the full role stack end-to-end."""
+    """Two trainer-role peers bootstrap off one DHT node, form a real
+    2-peer averaging group, and both advance the global step — the full
+    role stack end-to-end."""
+    import logging
+
     from dedloc_tpu.roles.common import build_dht
 
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    capture = _Capture()
+    logging.getLogger("dedloc_tpu").addHandler(capture)
     root_args = _args(tmp_path)
     root_dht, _ = build_dht(root_args)
     try:
@@ -151,7 +162,11 @@ def test_two_trainer_roles_collaborate(tmp_path):
         assert not errors, errors
         assert len(results) == 2
         assert max(int(s.step) for s in results.values()) >= 1
+        # a REAL group formed (failed-round local applies also advance
+        # steps and would otherwise mask a dead averaging path)
+        assert any("group=2" in m for m in records), "no 2-peer group formed"
     finally:
+        logging.getLogger("dedloc_tpu").removeHandler(capture)
         root_dht.shutdown()
 
 
