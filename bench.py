@@ -88,16 +88,20 @@ def main() -> None:
     # train step (~86 vs ~77 samples/s on a v5e, measured 2026-07); off-TPU
     # it would run in interpret mode, so CI smoke keeps the dense path
     impl = "flash" if jax.default_backend() == "tpu" else "dense"
-    # measurement overrides (remat sweep for BASELINE.md): not part of the
-    # headline recipe, which stays fixed for round-over-round comparability
-    remat = os.environ.get("DEDLOC_BENCH_REMAT", "dots_no_batch")
+    # measurement overrides (remat sweep for BASELINE.md). Round-3 recipe
+    # change: default policy dots_no_batch -> dots_no_batch_attn and block
+    # length 5 -> 10 iters (see BASELINE.md round-3 notes for both the old-
+    # and new-methodology numbers so rounds stay comparable).
+    remat = os.environ.get("DEDLOC_BENCH_REMAT", "dots_no_batch_attn")
     per_step_env = int(os.environ.get("DEDLOC_BENCH_BATCH", "0"))
     if tiny:  # CI smoke on CPU
         cfg = AlbertConfig.tiny(remat_policy=remat, attention_impl=impl)
         accum, per_step, seq, iters = 2, 4, 64, 3
     else:
         cfg = AlbertConfig.large(remat_policy=remat, attention_impl=impl)
-        accum, per_step, seq, iters = 2, 32, 512, 5
+        # iters per block: one scalar readback (~90 ms tunnel RTT) per block,
+        # so longer blocks report closer to the true device rate
+        accum, per_step, seq, iters = 2, 32, 512, 10
     if per_step_env:
         per_step = per_step_env
     # gathered masked-position MLM head: vocab projection only where labels

@@ -186,7 +186,10 @@ class TrainingArguments:
     (albert/arguments.py:104-128)."""
 
     model_size: str = "large"  # tiny (CI fixture) | large
-    remat_policy: str = ""  # override model remat: nothing|dots|dots_no_batch|full
+    # override model remat: nothing|dots|dots_no_batch|dots_no_batch_attn
+    # (dots_no_batch_attn additionally saves flash-attention residuals — the
+    # fastest measured policy for the seq-512 recipe on v5e; models/albert.py)
+    remat_policy: str = ""
     attention_impl: str = ""  # override: dense|blockwise|flash|ring
     vocab_size: int = 0  # override model vocab (0 = size default); must cover
     # the dataset tokenizer's vocab (checked against the shard dir's meta.json)

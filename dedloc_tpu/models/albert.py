@@ -203,6 +203,12 @@ class AlbertLayer(nn.Module):
                             name="layernorm")(hidden + ffn).astype(cfg.dtype)
 
 
+def _pallas_outputs_saveable(prim, *_, **__) -> bool:
+    """Remat-policy predicate: save the outputs of Pallas kernels (here the
+    flash-attention out/lse residuals) instead of re-running them backward."""
+    return getattr(prim, "name", "") == "pallas_call"
+
+
 class _ScannedAlbertLayer(nn.Module):
     """scan body: carry = hidden states; attn_bias broadcast; no per-step out."""
 
@@ -218,6 +224,16 @@ class _ScannedAlbertLayer(nn.Module):
                 "dots": jax.checkpoint_policies.checkpoint_dots,
                 "dots_no_batch": (
                     jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                ),
+                # dots_no_batch + flash-attention outputs (out, lse): the
+                # custom-VJP backward then runs straight from saved residuals
+                # instead of re-running the forward kernel during remat
+                # (~30 MB/layer extra HBM at B=32, measured step win on v5e)
+                "dots_no_batch_attn": (
+                    jax.checkpoint_policies.save_from_both_policies(
+                        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                        _pallas_outputs_saveable,
+                    )
                 ),
             }[self.cfg.remat_policy]
             layer_cls = nn.remat(AlbertLayer, policy=policy)

@@ -59,7 +59,7 @@ def _t(x):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale):
+                acc_ref, m_ref, l_ref, *, scale, gh):
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -69,59 +69,79 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0]  # [Bq, D]
-    k = k_ref[0]  # [Bk, D]
-    v = v_ref[0]
-    b = bias_ref[0]  # [1, Bk]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale + b.astype(jnp.float32)
+    # gh heads per program (unrolled): one grid step's DMAs and semaphore
+    # work amortise over gh heads' matmuls — at D=64 the per-head dots are
+    # too small to hide the per-program overhead (measured on v5e).
+    for g in range(gh):
+        q = q_ref[g]  # [Bq, D]
+        k = k_ref[g]  # [Bk, D]
+        v = v_ref[g]
+        b = bias_ref[g]  # [1, Bk]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale + b.astype(jnp.float32)
 
-    m_prev, l_prev = m_ref[:], l_ref[:]  # [1, Bq] rows
-    m_new = jnp.maximum(m_prev, _t(jnp.max(s, axis=-1, keepdims=True)))
-    p = jnp.exp(s - _t(m_new))
-    corr = jnp.exp(m_prev - m_new)  # [1, Bq]
-    l_ref[:] = l_prev * corr + _t(jnp.sum(p, axis=-1, keepdims=True))
-    m_ref[:] = m_new
-    pv = jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    acc_ref[:] = acc_ref[:] * _t(corr) + pv
+        # softmax state lives as COLUMNS [Bq, 1] in scratch (it never touches
+        # HBM) so the running max/denominator broadcast against s with zero
+        # cross-lane relayouts; only the lse OUTPUT is a row (HBM tiling).
+        m_prev, l_prev = m_ref[g], l_ref[g]  # [Bq, 1] columns
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)  # [Bq, 1]
+        l_ref[g] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[g] = m_new
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[g] = acc_ref[g] * corr + pv
 
     @pl.when(kb == nk - 1)
     def _flush():
-        safe_l = jnp.maximum(l_ref[:], 1e-30)  # [1, Bq]
-        o_ref[0] = (acc_ref[:] / _t(safe_l)).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[:] + jnp.log(safe_l)  # [1, Bq]
+        for g in range(gh):
+            safe_l = jnp.maximum(l_ref[g], 1e-30)  # [Bq, 1]
+            o_ref[g] = (acc_ref[g] / safe_l).astype(o_ref.dtype)
+            lse_ref[g] = _t(m_ref[g] + jnp.log(safe_l))  # -> [1, Bq] row
+
+
+def _pick_heads(bh: int, block_q: int, block_k: int, budget_mb: float = 6.0):
+    """Heads per program: amortise grid-step overhead while keeping the
+    per-head transient (fp32 scores + bf16 probs ≈ 6·Bq·Bk bytes) within a
+    conservative VMEM budget (~16 MB/core total on v5e)."""
+    per_head_mb = 6.0 * block_q * block_k / 2**20
+    g = 8
+    while g > 1 and (bh % g or g * per_head_mb > budget_mb):
+        g //= 2
+    return g
 
 
 def _fwd(q3, k3, v3, bias3, block_q, block_k, interpret):
     bh, s, d = q3.shape
     bq = _pick_block(s, block_q)
     bk = _pick_block(s, block_k)
+    gh = _pick_heads(bh, bq, bk)
     scale = 1.0 / (d ** 0.5)
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale),
-        grid=(bh, s // bq, s // bk),
+        functools.partial(_fwd_kernel, scale=scale, gh=gh),
+        grid=(bh // gh, s // bq, s // bk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, kb: (i, kb, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, kb: (i, kb, 0)),
-            pl.BlockSpec((1, 1, bk), lambda i, j, kb: (i, 0, kb)),
+            pl.BlockSpec((gh, bq, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((gh, bk, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((gh, bk, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((gh, 1, bk), lambda i, j, kb: (i, 0, kb)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, 1, bq), lambda i, j, kb: (i, 0, j)),
+            pl.BlockSpec((gh, bq, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((gh, 1, bq), lambda i, j, kb: (i, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
             jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bq, d), jnp.float32),
-            pltpu.VMEM((1, bq), jnp.float32),
-            pltpu.VMEM((1, bq), jnp.float32),
+            pltpu.VMEM((gh, bq, d), jnp.float32),
+            pltpu.VMEM((gh, bq, 1), jnp.float32),
+            pltpu.VMEM((gh, bq, 1), jnp.float32),
         ],
         interpret=interpret,
     )(q3, k3, v3, bias3)
@@ -132,7 +152,7 @@ def _fwd(q3, k3, v3, bias3, block_q, block_k, interpret):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref,
-               dq_ref, dq_acc_ref, *, scale):
+               dq_ref, dq_acc_ref, *, scale, gh):
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -140,34 +160,36 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref,
     def _init():
         dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    b = bias_ref[0]  # [1, Bk]
-    do = do_ref[0]  # native (bf16) dtype — MXU runs at full rate
-    lse = _t(lse_ref[0])  # [1, Bq] row -> [Bq, 1] column
-    delta = _t(delta_ref[0])
+    for g in range(gh):
+        q = q_ref[g]
+        k = k_ref[g]
+        v = v_ref[g]
+        b = bias_ref[g]  # [1, Bk]
+        do = do_ref[g]  # native (bf16) dtype — MXU runs at full rate
+        lse = _t(lse_ref[g])  # [1, Bq] row -> [Bq, 1] column
+        delta = _t(delta_ref[g])
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale + b.astype(jnp.float32)
-    p = jnp.exp(s - lse)  # [Bq, Bk]
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    ds = p * (dp - delta) * scale
-    dq_acc_ref[:] = dq_acc_ref[:] + jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale + b.astype(jnp.float32)
+        p = jnp.exp(s - lse)  # [Bq, Bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        dq_acc_ref[g] = dq_acc_ref[g] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
     @pl.when(kb == nk - 1)
     def _flush():
-        dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
+        for g in range(gh):
+            dq_ref[g] = dq_acc_ref[g].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, scale):
+                dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, scale, gh):
     qb = pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -176,35 +198,74 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref,
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    b = bias_ref[0]  # [1, Bk]
-    do = do_ref[0]
-    lse = _t(lse_ref[0])  # [Bq, 1]
-    delta = _t(delta_ref[0])
+    for g in range(gh):
+        q = q_ref[g]
+        k = k_ref[g]
+        v = v_ref[g]
+        b = bias_ref[g]  # [1, Bk]
+        do = do_ref[g]
+        lse = _t(lse_ref[g])  # [Bq, 1]
+        delta = _t(delta_ref[g])
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale + b.astype(jnp.float32)
-    p = jnp.exp(s - lse)  # [Bq, Bk]
-    dv_acc_ref[:] = dv_acc_ref[:] + jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    ds = p * (dp - delta) * scale  # [Bq, Bk]
-    dk_acc_ref[:] = dk_acc_ref[:] + jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale + b.astype(jnp.float32)
+        p = jnp.exp(s - lse)  # [Bq, Bk]
+        dv_acc_ref[g] = dv_acc_ref[g] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale  # [Bq, Bk]
+        dk_acc_ref[g] = dk_acc_ref[g] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
     @pl.when(qb == nq - 1)
     def _flush():
-        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+        for g in range(gh):
+            dk_ref[g] = dk_acc_ref[g].astype(dk_ref.dtype)
+            dv_ref[g] = dv_acc_ref[g].astype(dv_ref.dtype)
+
+
+def _dqkv_fused_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref,
+                       delta_ref, dq_ref, dk_ref, dv_ref, *, scale, gh):
+    """Single-block backward: when one (Bq, Bk) tile covers the whole
+    sequence, dq/dk/dv share ONE score/prob computation and one set of
+    input DMAs instead of recomputing them in two kernels."""
+    for g in range(gh):
+        q = q_ref[g]
+        k = k_ref[g]
+        v = v_ref[g]
+        b = bias_ref[g]  # [1, Bk]
+        do = do_ref[g]
+        lse = _t(lse_ref[g])  # [Bq, 1]
+        delta = _t(delta_ref[g])
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale + b.astype(jnp.float32)
+        p = jnp.exp(s - lse)  # [Bq, Bk]
+        pb = p.astype(do.dtype)
+        dv_ref[g] = jax.lax.dot_general(
+            pb, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(dv_ref.dtype)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta) * scale).astype(q.dtype)  # [Bq, Bk]
+        dq_ref[g] = jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(dq_ref.dtype)
+        dk_ref[g] = jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(dk_ref.dtype)
 
 
 def _bwd(q3, k3, v3, bias3, out, lse, do, block_q, block_k, interpret):
@@ -215,48 +276,84 @@ def _bwd(q3, k3, v3, bias3, out, lse, do, block_q, block_k, interpret):
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )[:, None, :]  # [BH, 1, S] row layout (see module docstring)
+    if bq == s and bk == s:
+        return _bwd_fused(q3, k3, v3, bias3, lse, do, delta, interpret)
+    # bwd transients per head are ~3x the fwd's (s, p, dp, ds live at once)
+    gh = _pick_heads(bh, bq, bk, budget_mb=4.0)
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale),
-        grid=(bh, s // bq, s // bk),
+        functools.partial(_dq_kernel, scale=scale, gh=gh),
+        grid=(bh // gh, s // bq, s // bk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, kb: (i, kb, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, kb: (i, kb, 0)),
-            pl.BlockSpec((1, 1, bk), lambda i, j, kb: (i, 0, kb)),
-            pl.BlockSpec((1, 1, bq), lambda i, j, kb: (i, 0, j)),
-            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, 1, bq), lambda i, j, kb: (i, 0, j)),
+            pl.BlockSpec((gh, bq, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((gh, bk, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((gh, bk, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((gh, 1, bk), lambda i, j, kb: (i, 0, kb)),
+            pl.BlockSpec((gh, 1, bq), lambda i, j, kb: (i, 0, j)),
+            pl.BlockSpec((gh, bq, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((gh, 1, bq), lambda i, j, kb: (i, 0, j)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
+        out_specs=pl.BlockSpec((gh, bq, d), lambda i, j, kb: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((gh, bq, d), jnp.float32)],
         interpret=interpret,
     )(q3, k3, v3, bias3, lse, do, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale),
-        grid=(bh, s // bk, s // bq),
+        functools.partial(_dkv_kernel, scale=scale, gh=gh),
+        grid=(bh // gh, s // bk, s // bq),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, qb: (i, qb, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, qb: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, qb: (i, j, 0)),
-            pl.BlockSpec((1, 1, bk), lambda i, j, qb: (i, 0, j)),
-            pl.BlockSpec((1, 1, bq), lambda i, j, qb: (i, 0, qb)),
-            pl.BlockSpec((1, bq, d), lambda i, j, qb: (i, qb, 0)),
-            pl.BlockSpec((1, 1, bq), lambda i, j, qb: (i, 0, qb)),
+            pl.BlockSpec((gh, bq, d), lambda i, j, qb: (i, qb, 0)),
+            pl.BlockSpec((gh, bk, d), lambda i, j, qb: (i, j, 0)),
+            pl.BlockSpec((gh, bk, d), lambda i, j, qb: (i, j, 0)),
+            pl.BlockSpec((gh, 1, bk), lambda i, j, qb: (i, 0, j)),
+            pl.BlockSpec((gh, 1, bq), lambda i, j, qb: (i, 0, qb)),
+            pl.BlockSpec((gh, bq, d), lambda i, j, qb: (i, qb, 0)),
+            pl.BlockSpec((gh, 1, bq), lambda i, j, qb: (i, 0, qb)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda i, j, qb: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, qb: (i, j, 0)),
+            pl.BlockSpec((gh, bk, d), lambda i, j, qb: (i, j, 0)),
+            pl.BlockSpec((gh, bk, d), lambda i, j, qb: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), k3.dtype),
             jax.ShapeDtypeStruct((bh, s, d), v3.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bk, d), jnp.float32),
-            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((gh, bk, d), jnp.float32),
+            pltpu.VMEM((gh, bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, bias3, lse, do, delta)
+    return dq, dk, dv
+
+
+def _bwd_fused(q3, k3, v3, bias3, lse, do, delta, interpret):
+    bh, s, d = q3.shape
+    scale = 1.0 / (d ** 0.5)
+    # fused kernel holds s, p, dp, ds (~4 full tiles) at once per head
+    gh = _pick_heads(bh, s, s, budget_mb=3.0)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_dqkv_fused_kernel, scale=scale, gh=gh),
+        grid=(bh // gh,),
+        in_specs=[
+            pl.BlockSpec((gh, s, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((gh, s, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((gh, s, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((gh, 1, s), lambda i: (i, 0, 0)),
+            pl.BlockSpec((gh, 1, s), lambda i: (i, 0, 0)),
+            pl.BlockSpec((gh, s, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((gh, 1, s), lambda i: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((gh, s, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((gh, s, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((gh, s, d), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v3.dtype),
         ],
         interpret=interpret,
     )(q3, k3, v3, bias3, lse, do, delta)

@@ -38,12 +38,19 @@ def test_forward_with_mask_bias(rng):
     np.testing.assert_allclose(out, out2, atol=2e-5, rtol=2e-5)
 
 
-def test_gradients_match_dense(rng):
+@pytest.mark.parametrize("block_q,block_k", [(32, 16), (64, 64)])
+def test_gradients_match_dense(rng, block_q, block_k):
+    # (64, 64) covers the whole sequence per tile -> the FUSED single-kernel
+    # backward (_dqkv_fused_kernel), the path production seq-512 training
+    # takes with the default block sizes; (32, 16) covers the two-kernel path
     q, k, v = _qkv(rng, b=1, s=64, h=2, d=16)
     bias = jnp.zeros((1, 64))
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, bias, block_q=32, block_k=16) ** 2)
+        return jnp.sum(
+            flash_attention(q, k, v, bias, block_q=block_q, block_k=block_k)
+            ** 2
+        )
 
     def loss_dense(q, k, v):
         return jnp.sum(dense_attention(q, k, v, bias) ** 2)
