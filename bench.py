@@ -74,7 +74,59 @@ def albert_train_flops_per_sample(cfg, seq: int, max_pred: int) -> float:
     return 3.0 * fwd  # bwd = 2x fwd matmul FLOPs
 
 
+def run_codec() -> None:
+    """Reproducible wire-path bench (DEDLOC_BENCH=codec): serialize +
+    deserialize the ALBERT-large param tree (~17.8M fp32 params, matching
+    what a peer actually ships per averaging round) through the fp16+CRC32C
+    wire codec (native/wirecodec.cpp with numpy fallback). Baseline anchor:
+    round-1 measured 102 ms serialize on the same-sized tree (BASELINE.md)."""
+    from dedloc_tpu.core.serialization import (
+        CompressionType,
+        deserialize_tree,
+        serialize_tree,
+    )
+
+    rng = np.random.default_rng(0)
+    # ALBERT-large's tensors: embeddings + factorized proj + the one shared
+    # layer + pooler + MLM head ≈ 17.8M params (full tree is 17.97M)
+    tree = {
+        "word_embeddings": rng.standard_normal((30000, 128)).astype(np.float32),
+        "position_embeddings": rng.standard_normal((512, 128)).astype(np.float32),
+        "token_type_embeddings": rng.standard_normal((2, 128)).astype(np.float32),
+        "embedding_projection": rng.standard_normal((128, 1024)).astype(np.float32),
+        "attn_qkv": rng.standard_normal((3, 1024, 1024)).astype(np.float32),
+        "attn_out": rng.standard_normal((1024, 1024)).astype(np.float32),
+        "ffn_in": rng.standard_normal((1024, 4096)).astype(np.float32),
+        "ffn_out": rng.standard_normal((4096, 1024)).astype(np.float32),
+        "pooler": rng.standard_normal((1024, 1024)).astype(np.float32),
+        "mlm_dense": rng.standard_normal((1024, 128)).astype(np.float32),
+        "mlm_bias": rng.standard_normal((30000,)).astype(np.float32),
+    }
+    n_params = sum(int(v.size) for v in tree.values())
+    blob = serialize_tree(tree, CompressionType.FLOAT16)  # warm the codec
+    ser = des = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        blob = serialize_tree(tree, CompressionType.FLOAT16)
+        ser = min(ser, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        deserialize_tree(blob)
+        des = min(des, time.perf_counter() - t0)
+    print(json.dumps({
+        "metric": "wirecodec_fp16_serialize_ms",
+        "value": round(ser * 1e3, 2),
+        "unit": "ms",
+        "vs_baseline": round(102.0 / (ser * 1e3), 3),
+        "deserialize_ms": round(des * 1e3, 2),
+        "n_params": n_params,
+        "wire_mb": round(len(blob) / 2**20, 1),
+    }))
+
+
 def main() -> None:
+    if os.environ.get("DEDLOC_BENCH") == "codec":
+        run_codec()
+        return
     from dedloc_tpu.models.albert import (
         AlbertConfig,
         AlbertForPreTraining,

@@ -218,7 +218,7 @@ def run_model(peak):
 
     accum, per_step, seq = 2, 32, 512
     max_pred = max_predictions_for(seq)
-    model, cfg = make_model("dots_no_batch", "flash")
+    model, cfg = make_model("dots_no_batch_attn", "flash")
     rng = jax.random.PRNGKey(0)
     params = model.init(rng, jnp.zeros((per_step, seq), jnp.int32))["params"]
     batch = make_batch(cfg, accum, per_step, seq, max_pred)
@@ -245,7 +245,8 @@ def run_model(peak):
              k_lo=2, k_hi=8, peak=peak)
 
     # fwd+bwd under each remat policy / attention impl (per micro-batch)
-    for policy, impl in (("dots_no_batch", "flash"), ("nothing", "flash"),
+    for policy, impl in (("dots_no_batch_attn", "flash"),
+                         ("dots_no_batch", "flash"), ("nothing", "flash"),
                          ("dots", "flash"), ("dots_no_batch", "dense"),
                          ("nothing", "dense")):
         m, _ = make_model(policy, impl)
