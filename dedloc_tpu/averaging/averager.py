@@ -33,7 +33,7 @@ from dedloc_tpu.core.serialization import (
 )
 from dedloc_tpu.core.timeutils import get_dht_time
 from dedloc_tpu.dht.dht import DHT
-from dedloc_tpu.dht.protocol import RPCClient, RPCServer
+from dedloc_tpu.dht.protocol import RPCClient, RPCError, RPCServer
 from dedloc_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -149,24 +149,78 @@ class DecentralizedAverager:
                     )
 
                     async def keep_registered() -> None:
-                        # a dropped relay connection silently unregisters us
-                        # (the relay maps peer -> that connection's writer);
-                        # without re-registration every round where we lead
-                        # or host would fail for the rest of the run
+                        # ACTIVE liveness probe: a dropped relay connection
+                        # silently unregisters us, and a half-open one (relay
+                        # power loss, NAT mapping expiry with no FIN) never
+                        # raises EOF — so ping the relay over the parked
+                        # connection every period. The ping shares the
+                        # ordered byte stream with multi-MB relayed tensor
+                        # frames, so a single slow pong is NOT evidence of
+                        # death: generous timeout, an RPC-level error reply
+                        # counts as alive (the connection answered), and the
+                        # connection is only dropped after two consecutive
+                        # silent failures.
+                        ping_failures = 0
                         while True:
                             await asyncio.sleep(5.0)
-                            if relay_ep not in self.client._conns:
+                            if relay_ep in self.client._conns:
                                 try:
-                                    await self.client.register_with_relay(
-                                        relay_ep, self.peer_id
+                                    await self.client.call(
+                                        relay_ep, "relay.ping", {},
+                                        timeout=10.0,
                                     )
-                                    logger.info("re-registered with relay")
-                                except Exception as e:  # noqa: BLE001
-                                    logger.debug(f"relay re-register: {e!r}")
+                                    ping_failures = 0
+                                    continue
+                                except RPCError:
+                                    ping_failures = 0  # answered => alive
+                                    continue
+                                except Exception:  # noqa: BLE001
+                                    ping_failures += 1
+                                    if ping_failures < 2:
+                                        continue
+                                    self.client._drop(
+                                        relay_ep,
+                                        ConnectionResetError(
+                                            "relay ping timed out twice"
+                                        ),
+                                    )
+                                    ping_failures = 0
+                            try:
+                                await self.client.register_with_relay(
+                                    relay_ep, self.peer_id
+                                )
+                                logger.info("re-registered with relay")
+                            except Exception as e:  # noqa: BLE001
+                                logger.debug(f"relay re-register: {e!r}")
 
                     self._relay_keepalive = asyncio.ensure_future(
                         keep_registered()
                     )
+                # NAT traversal (dht/nat.py): calls to relay: endpoints
+                # upgrade to direct paths — connection reversal when we are
+                # public, hole punch when both sides are private — so the
+                # relay carries only handshakes, never tensor bytes
+                from dedloc_tpu.dht.nat import NatTraversal
+
+                if self.endpoint is not None and self.server.port is not None:
+                    self.nat = NatTraversal(
+                        self.client, self.server, self.peer_id,
+                        advertised=self.endpoint,
+                    )
+                elif client_mode and relay:
+                    conn = self.client._conns.get(relay_ep)
+                    bind_host = "127.0.0.1"
+                    if conn is not None:
+                        sockname = conn[1].get_extra_info("sockname")
+                        if sockname:
+                            bind_host = sockname[0]
+                    self.nat = NatTraversal(
+                        self.client, self.server, self.peer_id,
+                        advertised=None, bind_host=bind_host,
+                    )
+                else:
+                    self.nat = None
+
                 self.allreduce = GroupAllReduce(
                     self.client,
                     self.server,
@@ -200,26 +254,33 @@ class DecentralizedAverager:
         weight: float,
         round_id: str,
         return_future: bool = False,
+        expected_size: Optional[int] = None,
     ):
         """Average ``tree`` with whatever group forms for ``round_id``.
 
         Returns (averaged_tree | None, group_size); None means the round
         failed and the caller should proceed with its local values
         (reference semantics: a failed group costs one round, nothing else).
+
+        ``expected_size``: the collaboration's live peer count, if known —
+        lets the leader assemble the moment the group is full instead of
+        idling out the straggler window (matchmaking.form_group).
         """
 
         def _run(node):
-            return self._step_async(tree, weight, round_id)
+            return self._step_async(tree, weight, round_id, expected_size)
 
         fut = self.dht.run_coroutine(_run, return_future=True)
         return fut if return_future else fut.result()
 
     async def _step_async(
-        self, tree: Dict[str, np.ndarray], weight: float, round_id: str
+        self, tree: Dict[str, np.ndarray], weight: float, round_id: str,
+        expected_size: Optional[int] = None,
     ) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
         try:
             group = await self.matchmaking.form_group(
-                round_id, schema=schema_fingerprint(tree)
+                round_id, schema=schema_fingerprint(tree),
+                expected_size=expected_size,
             )
         except MatchmakingFailed as e:
             logger.debug(f"matchmaking failed for {round_id}: {e}")

@@ -293,7 +293,18 @@ class CollaborativeOptimizer:
         self.performance_ema.pause()
         try:
             averaged, group_size = self.averager.step(
-                named, weight=float(self.local_samples_accumulated), round_id=round_id
+                named, weight=float(self.local_samples_accumulated),
+                round_id=round_id,
+                # tracker's live peer count: full group => assemble the
+                # moment the last partner joins; the straggler window then
+                # only pays off when peers are genuinely late. During cold
+                # start (num_peers <= 1: our own record may be the only
+                # visible one) keep the full window so a concurrent starter
+                # can still pair with us — the design the solo-grace path
+                # above depends on.
+                expected_size=(
+                    collab.num_peers if collab.num_peers >= 2 else None
+                ),
             )
             if averaged is not None and group_size == 1 and collab.num_peers > 1:
                 # we formed a group of one while partners exist: they may be

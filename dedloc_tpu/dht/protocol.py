@@ -10,11 +10,12 @@ peer's ``RelayService`` and registers; the connection then becomes
 bidirectional — relayed requests arrive on it as frames with a ``method``
 field and are dispatched against the client's ``reverse_handlers``. Anyone
 can then reach the private peer at the virtual endpoint
-``("relay:<host>:<port>:<peer_hex>", 0)``: ``RPCClient.call`` recognizes the
-form and wraps the call in a ``relay.call`` to the public peer, which pipes
-it down the registered connection and relays the reply back. NAT hole
-punching stays descoped (datacenter fleets); the relay covers the
-private↔private case end-to-end.
+``("relay:<host>:<port>:<peer_hex>", 0)``: ``RPCClient.call`` resolves the
+form by preferring a DIRECT path — an adopted hole-punched connection or a
+reversal route (dht/nat.py NatTraversal) — and only falls back to wrapping
+the call in ``relay.call`` to the public peer, which pipes it down the
+registered connection and relays the reply back. At steady state the relay
+carries handshakes, not tensor bytes.
 """
 from __future__ import annotations
 
@@ -74,16 +75,60 @@ class RPCServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self._writers: set = set()
         self.port: Optional[int] = None
-        # reply frames (no "method") arriving on inbound connections belong
-        # to the RelayService, which forwarded a request down that
-        # connection; called with (msg, writer) so replies are only accepted
-        # from the connection the request was piped down
-        self.reply_router: Optional[
-            Callable[[Dict[str, Any], asyncio.StreamWriter], None]
-        ] = None
+        # server-initiated calls piped DOWN an inbound connection (circuit
+        # relay forwarding, NAT reverse-connection routes): reply frames (no
+        # "method") are matched by id and VALIDATED against the writer the
+        # request went down — a reply arriving on any other connection
+        # (i.e. from a different peer) is discarded, so a stranger cannot
+        # forge results into someone else's call
+        self._pending_calls: Dict[
+            int, Tuple[asyncio.Future, asyncio.StreamWriter]
+        ] = {}
+        self._next_call_id = 0
 
     def register(self, method: str, handler: Handler) -> None:
         self._handlers[method] = handler
+
+    async def call_over(
+        self,
+        writer: asyncio.StreamWriter,
+        method: str,
+        args: Optional[Dict[str, Any]] = None,
+        timeout: float = 60.0,
+    ) -> Any:
+        """Invoke a method on the peer at the OTHER end of an inbound
+        connection (the peer serves it via ``RPCClient.reverse_handlers``).
+        This is how otherwise-unreachable peers are called back over the
+        connections they parked with us."""
+        self._next_call_id += 1
+        rid = self._next_call_id
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending_calls[rid] = (fut, writer)
+        try:
+            write_frame(
+                writer, {"id": rid, "method": method, "args": args or {}}
+            )
+            await writer.drain()
+            reply = await asyncio.wait_for(fut, timeout=timeout)
+        finally:
+            self._pending_calls.pop(rid, None)
+        if not reply.get("ok"):
+            raise RPCError(reply.get("error", "unknown remote error"))
+        return reply.get("result")
+
+    def _route_reply(self, msg, writer) -> None:
+        entry = self._pending_calls.get(msg.get("id"))
+        if entry is None:
+            return
+        fut, expected_writer = entry
+        if writer is not expected_writer:
+            logger.warning(
+                "discarding reply arriving on the wrong connection"
+            )
+            return
+        self._pending_calls.pop(msg.get("id"), None)
+        if not fut.done():
+            fut.set_result(msg)
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -111,11 +156,9 @@ class RPCServer:
                     msg = await read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
                     return
-                if msg.get("method") is None and self.reply_router is not None:
-                    # reply to a relayed request we piped down this
-                    # connection — the writer identifies WHICH connection,
-                    # so a stranger cannot complete someone else's call
-                    self.reply_router(msg, writer)
+                if msg.get("method") is None:
+                    # reply to a call_over we piped down this connection
+                    self._route_reply(msg, writer)
                     continue
                 asyncio.ensure_future(self._dispatch(peer, msg, writer))
         finally:
@@ -160,6 +203,9 @@ class RPCClient:
         # peer arrive on its outbound relay connection and dispatch here —
         # point this at an RPCServer's handler dict to expose its methods
         self.reverse_handlers: Dict[str, Handler] = {}
+        # NAT traversal policy (dht/nat.py NatTraversal attaches itself):
+        # consulted before falling back to the relay for relay: endpoints
+        self.nat = None
 
     async def _connect(self, endpoint: Endpoint):
         lock = self._conn_locks.setdefault(endpoint, asyncio.Lock())
@@ -218,8 +264,32 @@ class RPCClient:
         """Park this client's connection at a public peer's RelayService and
         return the virtual endpoint others can reach us at. The pooled
         connection stays open; ``reverse_handlers`` serve what arrives."""
+
+        async def _probe(_peer, _args):
+            # answered over the parked connection: proves to the relay that
+            # this registration's path is still alive when a newcomer tries
+            # to (re-)register the same peer id
+            return {"alive": True}
+
+        self.reverse_handlers.setdefault("relay.probe", _probe)
         await self.call(relay, "relay.register", {"peer_id": peer_id.hex()})
         return relay_endpoint(relay, peer_id)
+
+    def adopt_connection(
+        self,
+        endpoint: Endpoint,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+    ) -> None:
+        """Install an externally-established connection (NAT punch) into the
+        pool under ``endpoint`` — calls to that endpoint then ride it like
+        any dialed connection, and inbound requests on it dispatch via
+        ``reverse_handlers``."""
+        self._conns[endpoint] = (reader, writer)
+        self._pending[endpoint] = {}
+        self._readers[endpoint] = asyncio.ensure_future(
+            self._read_loop(endpoint, reader)
+        )
 
     def _drop(self, endpoint: Endpoint, exc: Exception) -> None:
         conn = self._conns.pop(endpoint, None)
@@ -241,24 +311,43 @@ class RPCClient:
     ) -> Any:
         """Invoke a remote method; raises on transport error / remote error.
 
-        A ``relay:`` endpoint is resolved by wrapping the call in a
-        ``relay.call`` to the public peer that hosts the target's
-        registration (circuit relay)."""
+        A ``relay:`` endpoint is resolved in preference order: an adopted
+        direct connection (NAT punch), a NAT upgrade attempt (connection
+        reversal / hole punch, dht/nat.py), and finally a ``relay.call``
+        wrapped to the public peer hosting the registration (circuit
+        relay)."""
         relayed = parse_relay_endpoint(endpoint)
         if relayed is not None:
             relay, peer_hex = relayed
-            inner_timeout = timeout or self.request_timeout
-            return await self.call(
-                relay,
-                "relay.call",
-                {
-                    "to": peer_hex,
-                    "method": method,
-                    "args": args or {},
-                    "timeout": inner_timeout,
-                },
-                timeout=inner_timeout + 5.0,
-            )
+            vep = (endpoint[0], int(endpoint[1]))
+            route = None
+            if vep in self._conns:
+                route = "conn"  # adopted punched connection: direct path
+            elif self.nat is not None and method not in _NAT_CONTROL:
+                route = await self.nat.upgrade(relay, peer_hex)
+                if route == "writer":
+                    writer = self.nat.direct_writer(peer_hex)
+                    if writer is not None and self.nat.server is not None:
+                        # reversal route: the target dialed us back; call it
+                        # over the parked inbound connection
+                        return await self.nat.server.call_over(
+                            writer, method, args or {},
+                            timeout=timeout or self.request_timeout,
+                        )
+                    route = None
+            if route != "conn":
+                inner_timeout = timeout or self.request_timeout
+                return await self.call(
+                    relay,
+                    "relay.call",
+                    {
+                        "to": peer_hex,
+                        "method": method,
+                        "args": args or {},
+                        "timeout": inner_timeout,
+                    },
+                    timeout=inner_timeout + 5.0,
+                )
         endpoint = (endpoint[0], int(endpoint[1]))
         _, writer = await self._connect(endpoint)
         self._next_id += 1
@@ -287,6 +376,13 @@ class RPCError(Exception):
     pass
 
 
+# NAT-coordination methods must not themselves trigger an upgrade attempt
+# (dht/nat.py defines them; duplicated here to avoid a circular import)
+_NAT_CONTROL = frozenset(
+    {"nat.reverse_connect", "nat.register", "nat.punch", "nat.hello"}
+)
+
+
 class RelayService:
     """Attachable circuit-relay for a public RPCServer
     (p2p/circuit-relay.md:15-68 capability: ``relay_enabled`` public node).
@@ -298,57 +394,78 @@ class RelayService:
     """
 
     def __init__(self, server: RPCServer, call_timeout: float = 60.0):
+        self.server = server
         self.call_timeout = call_timeout
         self._registered: Dict[str, asyncio.StreamWriter] = {}
-        # pending futures keyed by id, VALIDATED against the writer the
-        # request was forwarded on — a reply arriving on any other
-        # connection (i.e. from a different peer) is discarded, so a
-        # stranger cannot forge results into someone else's relayed call
-        self._pending: Dict[int, Tuple[asyncio.Future, asyncio.StreamWriter]] = {}
-        self._next_id = 0
+        # observability + test hook: recent methods piped through this relay
+        # (bounded — a long-lived relay must not grow without limit)
+        from collections import deque
+
+        self.piped_methods: "deque[str]" = deque(maxlen=512)
         self._rpc_register.__func__.rpc_wants_writer = True
         server.register("relay.register", self._rpc_register)
         server.register("relay.call", self._rpc_call)
-        server.reply_router = self._route_reply
+        server.register("relay.ping", self._rpc_ping)
+        server.register("relay.observed", self._rpc_observed)
 
     async def _rpc_register(self, peer: Endpoint, args, writer) -> dict:
-        self._registered[args["peer_id"]] = writer
+        peer_id = args["peer_id"]
+        current = self._registered.get(peer_id)
+        if (current is not None and current is not writer
+                and not current.is_closing()):
+            # Never silently overwrite a registration whose connection still
+            # ANSWERS: otherwise any host that can reach the relay could
+            # hijack another peer's virtual endpoint and receive its
+            # matchmaking/allreduce traffic. A half-open old connection
+            # (NAT mapping expired, no FIN — is_closing() stays False
+            # forever) must not block the legitimate re-registration the
+            # keepalive performs, so the OLD path is probed: alive => the
+            # newcomer is refused; dead/unresponsive => replaced.
+            try:
+                await self.server.call_over(
+                    current, "relay.probe", {}, timeout=2.0
+                )
+                raise PermissionError(
+                    f"peer {peer_id!r} already has a live registration"
+                )
+            except PermissionError:
+                raise
+            except Exception:  # noqa: BLE001 — old path dead: replace it
+                pass
+        self._registered[peer_id] = writer
         return {"registered": True}
 
-    def _route_reply(self, msg, writer) -> None:
-        entry = self._pending.get(msg.get("id"))
-        if entry is None:
-            return
-        fut, expected_writer = entry
-        if writer is not expected_writer:
-            logger.warning(
-                "discarding relayed reply arriving on the wrong connection"
-            )
-            return
-        self._pending.pop(msg.get("id"), None)
-        if not fut.done():
-            fut.set_result(msg)
+    async def _rpc_observed(self, peer: Endpoint, args) -> dict:
+        """Reflexive-address observation (the STUN-ish primitive real NAT
+        traversal needs): the address the relay sees for a registrant."""
+        writer = self._registered.get(args["to"])
+        if writer is None or writer.is_closing():
+            raise KeyError(f"no relayed peer {args['to']!r} registered here")
+        peername = writer.get_extra_info("peername") or (None, None)
+        return {"host": peername[0], "port": peername[1]}
+
+    async def _rpc_ping(self, peer: Endpoint, args) -> dict:
+        """Cheap liveness probe: registrants call this periodically over
+        their parked connection — a half-open TCP connection (relay power
+        loss, NAT mapping expiry with no FIN) times out here, and the
+        registrant reconnects + re-registers."""
+        return {"pong": True}
 
     async def _rpc_call(self, peer: Endpoint, args) -> Any:
         writer = self._registered.get(args["to"])
         if writer is None or writer.is_closing():
             self._registered.pop(args["to"], None)
             raise KeyError(f"no relayed peer {args['to']!r} registered here")
-        self._next_id += 1
-        rid = self._next_id
-        fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._pending[rid] = (fut, writer)
-        try:
-            write_frame(
-                writer,
-                {"id": rid, "method": args["method"], "args": args.get("args") or {}},
-            )
-            await writer.drain()
-            reply = await asyncio.wait_for(
-                fut, timeout=float(args.get("timeout") or self.call_timeout)
-            )
-        finally:
-            self._pending.pop(rid, None)
-        if not reply.get("ok"):
-            raise RPCError(reply.get("error", "unknown relayed error"))
-        return reply.get("result")
+        self.piped_methods.append(args["method"])
+        call_args = args.get("args") or {}
+        if args["method"] == "nat.punch":
+            # inject the caller's relay-observed (reflexive) address: behind
+            # a real NAT the self-reported bind host is an RFC1918 address
+            # the target could never dial
+            call_args = dict(call_args, observed_host=peer[0])
+        return await self.server.call_over(
+            writer,
+            args["method"],
+            call_args,
+            timeout=float(args.get("timeout") or self.call_timeout),
+        )

@@ -148,6 +148,10 @@ def test_two_trainer_roles_collaborate(tmp_path):
                         "--training.save_steps", "0",
                         "--training.output_dir", str(tmp_path / f"peer{idx}"),
                         "--training.seed", str(idx),
+                        # generous straggler window: early assembly makes the
+                        # aligned path instant; this bound only pays when the
+                        # partner is late under parallel-suite CPU load
+                        "--averager.averaging_expiration", "15",
                     ],
                 )
                 results[idx] = run_trainer(args)
@@ -195,6 +199,7 @@ def test_two_slice_peers_hybrid_ici_dcn(tmp_path):
                         "--training.save_steps", "0",
                         "--training.mesh_devices", "4",
                         "--training.mesh_device_offset", str(idx * 4),
+                        "--averager.averaging_expiration", "15",
                         "--training.output_dir",
                         str(tmp_path / f"slice{idx}"),
                         "--training.seed", str(idx),
@@ -409,6 +414,7 @@ def test_client_mode_trainer_collaborates_via_relay(tmp_path):
                         "--training.save_steps", "0",
                         "--training.output_dir", str(tmp_path / f"rp{idx}"),
                         "--training.seed", str(idx),
+                        "--averager.averaging_expiration", "15",
                     ] + extra,
                 )
                 results[idx] = run_trainer(args)
