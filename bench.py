@@ -123,9 +123,106 @@ def run_codec() -> None:
     }))
 
 
+def run_swav() -> None:
+    """SwAV ResNet-50 step bench (DEDLOC_BENCH=swav): the full jitted
+    multicrop train step — trunk fwd/bwd over 2x224 + 6x96 crops, prototypes
+    head, sinkhorn assignment in the loss, LARS update, prototype
+    re-normalization (swav_1node_resnet_submit.yaml recipe).
+
+    MFU uses XLA's own executed-FLOP count for the compiled step (convs
+    dominate; an analytic count would re-derive ResNet-50 conv by conv).
+    vs_baseline anchors on the SwAV paper's own wall-clock: 800 epochs of
+    ImageNet-1k on 64 V100s in ~50 h => ~88 samples/s per V100 peer."""
+    from dedloc_tpu.models.swav import (
+        SwAVConfig,
+        SwAVModel,
+        SwAVQueue,
+        SwAVTrainState,
+        make_swav_train_step,
+    )
+    from dedloc_tpu.optim import lars
+
+    V100_SWAV_SAMPLES_PER_SEC = 88.0
+    tiny = os.environ.get("DEDLOC_BENCH_TINY", "") == "1"
+    if tiny:
+        cfg = SwAVConfig.tiny()
+        sizes, counts = (32, 16), (2, 2)
+        batch, iters = 4, 2
+    else:
+        cfg = SwAVConfig(queue_length=3840)
+        sizes, counts = (224, 96), (2, 6)
+        # throughput saturates by B=128 (365/510/591/608 samples/s at
+        # B=16/32/64/128 on v5e, 2026-07-30)
+        batch = int(os.environ.get("DEDLOC_BENCH_BATCH", "128"))
+        iters = 5
+
+    model = SwAVModel(cfg)
+    rng = jax.random.PRNGKey(0)
+    crops = [
+        jax.random.normal(
+            jax.random.PRNGKey(i), (count * batch, size, size, 3),
+            jnp.float32,
+        )
+        for i, (size, count) in enumerate(zip(sizes, counts))
+    ]
+    variables = model.init(rng, crops, True)
+    tx = lars(learning_rate=0.6, momentum=0.9, weight_decay=1e-6)
+    state = jax.jit(
+        lambda p, bn: SwAVTrainState(
+            step=jnp.zeros([], jnp.int32),
+            params=p,
+            batch_stats=bn,
+            opt_state=tx.init(p),
+            queue=SwAVQueue.create(cfg, jax.random.PRNGKey(1))
+            if cfg.queue_length else None,
+        )
+    )(variables["params"], variables["batch_stats"])
+    step = make_swav_train_step(model, cfg, tx)
+
+    state, metrics = step(state, crops, False)
+    float(metrics["loss"])  # settle through the tunnel
+
+    best = float("inf")
+    for block in range(3):
+        start = time.perf_counter()
+        for _ in range(iters):
+            state, metrics = step(state, crops, False)
+        float(metrics["loss"])
+        best = min(best, time.perf_counter() - start)
+    samples_per_sec = iters * batch / best
+
+    result = {
+        "metric": "swav_resnet50_train_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 3),
+        "unit": "samples/sec",
+        "vs_baseline": round(samples_per_sec / V100_SWAV_SAMPLES_PER_SEC, 3),
+    }
+    peak = chip_peak_tflops()
+    if peak and not tiny:
+        try:
+            analysis = step.lower(state, crops, False).compile().cost_analysis()
+            if isinstance(analysis, list):
+                analysis = analysis[0]
+            flops_step = float(analysis.get("flops", 0.0))
+            if flops_step > 0:
+                result["mfu"] = round(
+                    samples_per_sec * flops_step / batch / (peak * 1e12), 4
+                )
+                result["model_tflops_per_sample"] = round(
+                    flops_step / batch / 1e12, 4
+                )
+        except Exception:
+            pass
+        result["chip"] = jax.devices()[0].device_kind
+    print(json.dumps(result))
+
+
 def main() -> None:
     if os.environ.get("DEDLOC_BENCH") == "codec":
         run_codec()
+        return
+    if os.environ.get("DEDLOC_BENCH") == "swav":
+        run_swav()
         return
     from dedloc_tpu.models.albert import (
         AlbertConfig,

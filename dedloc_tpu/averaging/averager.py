@@ -326,6 +326,14 @@ class DecentralizedAverager:
             blob = self._shared_state_blob
         if snapshot is None:
             raise FileNotFoundError("no state snapshot available yet")
+        if args.get("schema_only"):
+            # tensor names+shapes only (a few KB): what an aux peer needs to
+            # bootstrap its gradient template without downloading the full
+            # params+optimizer blob (hundreds of MB for real models)
+            tree, _metadata = snapshot
+            return {
+                "schema": {k: list(v.shape) for k, v in tree.items()}
+            }
         if blob is None:
             tree, metadata = snapshot
 
@@ -365,13 +373,37 @@ class DecentralizedAverager:
             subkey=self.peer_id,
         )
 
-    def load_state_from_peers(
-        self, timeout: float = 60.0
-    ) -> Optional[Tuple[Dict[str, Any], Dict[str, np.ndarray]]]:
-        """Download (metadata, tree) from any live state provider."""
+    def fetch_state_schema(
+        self, timeout: float = 15.0
+    ) -> Optional[Dict[str, tuple]]:
+        """{tensor name: shape} from any live state provider — the cheap
+        (KB-sized) sibling of ``load_state_from_peers`` for peers that need
+        only the tree's structure (aux template bootstrap)."""
+        providers = self._live_state_providers()
+
+        def _fetch(node):
+            async def fetch():
+                for ep in providers:
+                    try:
+                        reply = await self.client.call(
+                            ep, "state.get", {"schema_only": True},
+                            timeout=timeout,
+                        )
+                        return {
+                            k: tuple(v) for k, v in reply["schema"].items()
+                        }
+                    except Exception as e:  # noqa: BLE001 — next provider
+                        logger.debug(f"schema fetch from {ep} failed: {e!r}")
+                return None
+
+            return fetch()
+
+        return self.dht.run_coroutine(_fetch)
+
+    def _live_state_providers(self):
         entry = self.dht.get(f"{self.prefix}_state_providers", latest=True)
         if entry is None or not hasattr(entry.value, "items"):
-            return None
+            return []
         candidates = []
         for sk, v in entry.value.items():
             if sk == getattr(self, "peer_id", None):
@@ -384,7 +416,13 @@ class DecentralizedAverager:
                 continue
         # newest snapshot first — a stale provider must not win the race
         candidates.sort(key=lambda c: -c[0])
-        providers = [ep for _step, ep in candidates]
+        return [ep for _step, ep in candidates]
+
+    def load_state_from_peers(
+        self, timeout: float = 60.0
+    ) -> Optional[Tuple[Dict[str, Any], Dict[str, np.ndarray]]]:
+        """Download (metadata, tree) from any live state provider."""
+        providers = self._live_state_providers()
 
         def _fetch(node):
             async def fetch():

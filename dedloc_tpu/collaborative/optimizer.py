@@ -493,6 +493,28 @@ class CollaborativeOptimizer:
 
     # -------------------------------------------------------------- aux role
 
+    def bootstrap_aux_template(
+        self, timeout: float = 60.0
+    ) -> Optional[Dict[str, np.ndarray]]:
+        """Fetch the GRADIENT tensor shapes from a live state provider, so
+        an aux peer can join a collaboration knowing only the DHT peers —
+        the reference's aux bootstraps from the collaboration the same way
+        (run_aux.py:243-263). Uses the KB-sized schema-only reply, never the
+        full state blob. Returns None while nobody shares state yet."""
+        schema = self.averager.fetch_state_schema(timeout=timeout)
+        if schema is None:
+            return None
+        # shared state is the flattened (params, opt_state) tuple, so param
+        # leaves carry the "[0]" tuple-index prefix (_tree_to_named keystr
+        # naming); gradients are params-shaped => strip that prefix. A wrong
+        # template still fails cleanly at join time (schema handshake).
+        template = {
+            k[len("[0]"):]: np.zeros(shape, np.float32)
+            for k, shape in schema.items()
+            if k.startswith("[0]")
+        }
+        return template or None
+
     def step_aux(self, template: Dict[str, np.ndarray]) -> bool:
         """Auxiliary peer (run_aux.py:260-263): join the current round with
         zero weight, donating bandwidth. ``template`` gives tensor shapes."""

@@ -368,3 +368,83 @@ async def _maybe_async(fn, *args):
     if asyncio.iscoroutine(result):
         return await result
     return result
+
+
+# ------------------------------------------------- network token service
+
+
+class AuthService:
+    """Network face of ``AllowlistAuthServer``: attaches ``auth.issue`` to
+    an ``RPCServer`` (typically the coordinator's DHT node server) so
+    volunteers obtain signed access tokens with one RPC — the capability of
+    the reference's hosted auth endpoint (sahajbert/huggingface_auth.py:
+    46-143 PUTs the peer's public key and receives a signed AccessToken +
+    coordinator address)."""
+
+    def __init__(self, server, auth_server: AllowlistAuthServer):
+        self.auth = auth_server
+        server.register("auth.issue", self._rpc_issue)
+
+    async def _rpc_issue(self, peer, args) -> Dict:
+        response = self.auth.issue_token(
+            args["username"],
+            args["credential"],
+            bytes.fromhex(args["public_key"]),
+        )
+        response["authority_public_key"] = (
+            self.auth.authority_public_key.hex()
+        )
+        return response
+
+
+def remote_token_issuer(endpoint) -> Callable:
+    """``issue_fn`` for ``AllowlistAuthorizer`` that calls a remote
+    ``AuthService`` (async — runs inside the DHT event loop on refresh)."""
+
+    async def issue(username: str, credential: str, public_key: bytes) -> Dict:
+        from dedloc_tpu.dht.protocol import RPCClient
+
+        client = RPCClient(request_timeout=10.0)
+        try:
+            return await client.call(
+                endpoint,
+                "auth.issue",
+                {
+                    "username": username,
+                    "credential": credential,
+                    "public_key": public_key.hex(),
+                },
+            )
+        finally:
+            await client.close()
+
+    return issue
+
+
+def remote_auth_handshake(
+    endpoint, username: str, credential: str,
+    local_key: Optional[RSAPrivateKey] = None,
+) -> "AllowlistAuthorizer":
+    """Join-time auth (contributor notebook cell 2 capability): fetch the
+    first token synchronously — failing fast on bad credentials — and build
+    an authorizer that refreshes over the same endpoint. The authority
+    public key is taken from the endpoint's reply (trust-on-first-use;
+    organizers can distribute it out of band and compare)."""
+    import asyncio
+
+    key = local_key or RSAPrivateKey()
+    issue = remote_token_issuer(endpoint)
+
+    async def first():
+        return await issue(username, credential, key.public_bytes())
+
+    response = asyncio.run(first())
+    authority = bytes.fromhex(response["authority_public_key"])
+    authorizer = AllowlistAuthorizer(
+        username, credential, issue, authority, local_key=key
+    )
+    # seed the freshly-issued token so the first round needs no second RPC
+    token = AccessToken.from_wire(response["token"])
+    authorizer._token = token  # noqa: SLF001 — warm the cache
+    authorizer.coordinator_endpoint = response.get("coordinator_endpoint")
+    return authorizer

@@ -233,6 +233,19 @@ class TrainingArguments:
 
 
 @dataclass
+class AuthArguments:
+    """Gated-run credentials (sahajbert/huggingface_auth.py capability):
+    when ``username`` is set, the role fetches a signed access token from
+    ``endpoint`` (default: the first initial peer, where the coordinator
+    hosts the AuthService) and every matchmaking message rides signed
+    envelopes."""
+
+    username: str = ""
+    credential: str = ""
+    endpoint: str = ""  # "host:port"; empty = first initial peer
+
+
+@dataclass
 class CollaborationArguments:
     dht: DHTArguments = field(default_factory=DHTArguments)
     averager: AveragerArguments = field(default_factory=AveragerArguments)
@@ -240,6 +253,7 @@ class CollaborationArguments:
         default_factory=CollaborativeOptimizerArguments
     )
     training: TrainingArguments = field(default_factory=TrainingArguments)
+    auth: AuthArguments = field(default_factory=AuthArguments)
     wandb_project: Optional[str] = None
     bandwidth: float = 1000.0
 

@@ -20,29 +20,26 @@ from dedloc_tpu.collaborative.optimizer import (
 )
 from dedloc_tpu.core.config import CollaborationArguments, parse_config
 from dedloc_tpu.roles.common import (
+    build_authorizer,
     build_dht,
     build_model,
     build_optimizer,
     force_cpu_if_requested,
+    single_device_attention_impl,
 )
 from dedloc_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
 
-def run_aux(
-    args: CollaborationArguments,
-    poll_interval: float = 0.5,
-    max_iterations: int = 0,
-) -> int:
-    """Returns the number of averaging rounds joined (for tests)."""
-    force_cpu_if_requested()
-    # aux needs only gradient SHAPES, never runs the model — but they must
-    # match the trainers' exactly, so apply the same config overrides
+def _local_template(args: CollaborationArguments):
+    """Gradient shapes from the local model config — the offline fallback
+    when no state provider is live yet (shape-only)."""
+    impl = single_device_attention_impl(args.training.attention_impl)
     cfg, model = build_model(
         args.training.model_size,
         args.training.remat_policy,
-        args.training.attention_impl,
+        impl,
         args.training.vocab_size,
     )
     seq = min(args.training.seq_length, cfg.max_position_embeddings)
@@ -50,11 +47,27 @@ def run_aux(
         lambda r: model.init(r, jnp.zeros((1, seq), jnp.int32))["params"],
         jax.random.PRNGKey(0),
     )
-    template = {
+    return {
         k: np.zeros(v.shape, np.float32)
         for k, v in _tree_to_named(params).items()
     }
 
+
+def run_aux(
+    args: CollaborationArguments,
+    poll_interval: float = 0.5,
+    max_iterations: int = 0,
+) -> int:
+    """Returns the number of averaging rounds joined (for tests).
+
+    The gradient-shape template SELF-BOOTSTRAPS from a live state provider
+    (run_aux.py:243-263 capability: the aux learns the model from the
+    collaboration, not from the caller); the local model config is only the
+    fallback while nobody shares state yet."""
+    force_cpu_if_requested()
+    # gated runs: aux peers need envelopes too (leaders reject unsigned
+    # joins; gated joiners reject unsigned leader replies)
+    authorizer, authority_public_key = build_authorizer(args)
     tx = build_optimizer(args)
     dht, _public_key = build_dht(args)
     logger.info(f"aux peer DHT listening on {dht.port}")
@@ -72,12 +85,34 @@ def run_aux(
         auxiliary=True,
         advertised_host=args.dht.advertised_host or None,
         allow_state_sharing=False,
+        authorizer=authorizer,
+        authority_public_key=authority_public_key,
         verbose=True,
     )
     rounds = iterations = 0
+    template = fallback = None
     try:
         while True:
-            if opt.step_aux(template):
+            if template is None:
+                # self-bootstrap keeps retrying until a provider appears —
+                # a late-started aux needs no model knowledge at all
+                template = opt.bootstrap_aux_template(timeout=10.0)
+                if template is not None:
+                    logger.info(
+                        f"bootstrapped gradient template from a state "
+                        f"provider ({len(template)} tensors)"
+                    )
+            current = template
+            if current is None:
+                # nobody shares state yet: derive shapes locally so the
+                # collaboration's very first rounds still get bandwidth
+                if fallback is None:
+                    fallback = _local_template(args)
+                    logger.info(
+                        "no state provider yet; using local model shapes"
+                    )
+                current = fallback
+            if opt.step_aux(current):
                 rounds += 1
                 logger.info(f"joined averaging round (total {rounds})")
             iterations += 1

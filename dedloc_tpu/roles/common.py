@@ -68,6 +68,46 @@ def build_optimizer(args: CollaborationArguments):
     )
 
 
+def single_device_attention_impl(impl: str) -> str:
+    """Attention impl for shape-only / single-device roles (aux template
+    fallback, evaluate): 'ring' needs the trainer's sequence-parallel mesh
+    to trace, but every impl is exact and shares one param tree, so it
+    safely degrades to 'dense' outside the trainer."""
+    return "dense" if impl == "ring" else impl
+
+
+def build_authorizer(args: CollaborationArguments):
+    """Gated-run handshake (contributor notebook cell 2 / huggingface_auth
+    capability): when --auth.username is set, fetch a signed access token
+    from the AuthService (default host: the first initial peer, where the
+    coordinator attaches it) and return (authorizer, authority_public_key);
+    (None, None) for open runs."""
+    if not args.auth.username:
+        return None, None
+    spec = args.auth.endpoint or (
+        args.dht.initial_peers[0] if args.dht.initial_peers else ""
+    )
+    if not spec:
+        raise ValueError(
+            "--auth.username given but no --auth.endpoint and no "
+            "--dht.initial_peers to default to"
+        )
+    host, _, port = spec.rpartition(":")
+    from dedloc_tpu.core.auth import remote_auth_handshake
+
+    authorizer = remote_auth_handshake(
+        (host, int(port)), args.auth.username, args.auth.credential
+    )
+    from dedloc_tpu.core.timeutils import get_dht_time
+
+    remaining = authorizer._token.expiration_time - get_dht_time()
+    logger.info(
+        f"authorized as {args.auth.username!r} "
+        f"(token valid for {remaining:.0f}s; auto-refreshes)"
+    )
+    return authorizer, authorizer.authority_public_key
+
+
 def build_dht(args: CollaborationArguments, client_mode: Optional[bool] = None):
     """DHT with the signed-metrics validator chain. Returns (dht, subkey)."""
     validators, public_key = make_validators(args.dht.experiment_prefix)

@@ -42,6 +42,11 @@ class CoordinatorExtraArguments:
     hub_git_dir: str = ""
     hub_git_remote: str = ""
     hub_mirror_dir: str = ""
+    # gated runs: "user:credential,user2:credential2" — hosts the token
+    # AuthService on this coordinator's DHT server (the reference's hosted
+    # auth endpoint, huggingface_auth.py:46-143); volunteers then join with
+    # --auth.username/--auth.credential pointed at this coordinator
+    auth_allowlist: str = ""
 
 
 def run_coordinator(
@@ -64,6 +69,24 @@ def run_coordinator(
     dht, _public_key = build_dht(args)
     logger.info(f"coordinator DHT root listening on {dht.port}")
 
+    if extra.auth_allowlist:
+        from dedloc_tpu.core.auth import AllowlistAuthServer, AuthService
+
+        allow = dict(
+            pair.split(":", 1) for pair in extra.auth_allowlist.split(",")
+        )
+        auth_server = AllowlistAuthServer(
+            allow, coordinator_endpoint=dht.get_visible_address()
+        )
+
+        async def _attach(node):
+            AuthService(node.server, auth_server)
+
+        dht.run_coroutine(_attach)
+        logger.info(
+            f"auth service up ({len(allow)} allowlisted users); run is gated"
+        )
+
     averager: Optional[DecentralizedAverager] = None
     if extra.upload_interval > 0:
         # listens for state only; contributes no gradients and no bandwidth
@@ -75,6 +98,8 @@ def run_coordinator(
         )
 
     wandb_run = _maybe_wandb(args)
+    uploads = {"thread": None}  # per-coordinator upload state (NOT global:
+    # tests run several coordinators in one process)
     current_step = -1
     last_upload = get_dht_time()
     iterations = 0
@@ -100,7 +125,9 @@ def run_coordinator(
                     and extra.upload_interval > 0
                     and get_dht_time() - last_upload >= extra.upload_interval
                 ):
-                    _pull_and_save(args, averager, current_step, upload_fn)
+                    _pull_and_save(
+                        args, averager, current_step, upload_fn, uploads
+                    )
                     last_upload = get_dht_time()
 
             iterations += 1
@@ -108,12 +135,20 @@ def run_coordinator(
                 break
             time.sleep(extra.refresh_period)
     finally:
+        # let an in-flight hub push finish (it is bounded by the git
+        # subprocess timeout): a push killed mid-flight can leave a stale
+        # lock in the work tree, and the FINAL checkpoint of a run has no
+        # next attempt to cover it
+        t = uploads.get("thread")
+        if t is not None and t.is_alive():
+            logger.info("waiting for the in-flight hub upload to finish")
+            t.join(timeout=330.0)
         if averager is not None:
             averager.shutdown()
         dht.shutdown()
 
 
-def _pull_and_save(args, averager, step, upload_fn) -> None:
+def _pull_and_save(args, averager, step, upload_fn, uploads) -> None:
     result = averager.load_state_from_peers()
     if result is None:
         logger.warning("no state providers yet; skipping checkpoint")
@@ -128,13 +163,32 @@ def _pull_and_save(args, averager, step, upload_fn) -> None:
     )
     logger.info(f"saved collaboration checkpoint {path}")
     if upload_fn is not None:
-        try:
-            upload_fn(path, step)
-        except Exception as e:  # noqa: BLE001 — a hub blip must not kill the
-            # coordinator: metrics aggregation and the next upload attempt
-            # matter more than this one push (reference behavior: the git
-            # push runs in a fire-and-forget thread, run_first_peer.py:139)
-            logger.warning(f"hub upload failed for step {step}: {e}")
+        # background thread (reference behavior, run_first_peer.py:139): a
+        # slow push must not block metrics aggregation or checkpointing.
+        # One upload in flight at a time — a new checkpoint while the
+        # previous push still runs skips its upload (the next interval
+        # covers it; the shutdown path joins the last one so the final
+        # checkpoint is never abandoned).
+        prev = uploads.get("thread")
+        if prev is not None and prev.is_alive():
+            logger.warning(
+                f"previous hub upload still in flight; skipping step {step}"
+            )
+            return
+
+        def _do_upload(path=path, step=step):
+            try:
+                upload_fn(path, step)
+            except Exception as e:  # noqa: BLE001 — a hub blip must not
+                # kill the coordinator; the git helper is also bounded by a
+                # subprocess timeout so a stalled remote cannot wedge this
+                # thread forever
+                logger.warning(f"hub upload failed for step {step}: {e}")
+
+        import threading
+
+        uploads["thread"] = threading.Thread(target=_do_upload)
+        uploads["thread"].start()
 
 
 def _maybe_wandb(args: CollaborationArguments):

@@ -51,10 +51,13 @@ class EvalCLIArguments(CollaborationArguments):
 def run_eval(args: CollaborationArguments,
              extra: EvalArguments) -> dict:
     force_cpu_if_requested()
+    from dedloc_tpu.roles.common import single_device_attention_impl
+
+    impl = single_device_attention_impl(args.training.attention_impl)
     cfg, model = build_model(
         args.training.model_size,
         args.training.remat_policy,
-        args.training.attention_impl,
+        impl,
         args.training.vocab_size,
     )
     if not args.training.dataset_path:

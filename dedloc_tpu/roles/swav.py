@@ -159,6 +159,13 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
             use_queue = bool(
                 cfg.queue_length and opt.local_step >= cfg.queue_start_step
             )
+            if use_queue and not local.get("queue_engaged"):
+                local["queue_engaged"] = True
+                logger.info(
+                    f"queue engaged at global step {opt.local_step} "
+                    f"(queue_start_step={cfg.queue_start_step}, "
+                    f"length={cfg.queue_length})"
+                )
             local["grad_acc"], local["n_acc"], local["batch_stats"], \
                 local["queue"], metrics = accumulate(
                     state.params,

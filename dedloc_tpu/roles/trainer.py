@@ -47,6 +47,11 @@ logger = get_logger(__name__)
 
 def run_trainer(args: CollaborationArguments) -> TrainState:
     force_cpu_if_requested()
+    # gated runs: token handshake BEFORE any heavy setup, so bad credentials
+    # fail in milliseconds (contributor notebook cell-2 ordering)
+    from dedloc_tpu.roles.common import build_authorizer
+
+    authorizer, authority_public_key = build_authorizer(args)
     # slice-as-one-peer: with mesh_devices > 1 this process drives a
     # data-parallel mesh; the micro-batch grad mean lowers to ICI psums and
     # the collaboration sees the whole slice as a single member. A
@@ -157,6 +162,8 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         allow_state_sharing=args.optimizer.allow_state_sharing,
         mesh=mesh,
         opt_state_sharding=opt_sharding,
+        authorizer=authorizer,
+        authority_public_key=authority_public_key,
         verbose=True,
     )
     # catch up with the collaboration before training (:124-128)
@@ -368,9 +375,10 @@ def _make_batches(
         # weighted lazy mix + per-peer shuffle buffer + on-the-fly tokenize
         from dedloc_tpu.data.mlm import SpecialTokens, max_predictions_for
         from dedloc_tpu.data.streaming import (
+            make_text_source,
+            prefetch,
             split_sentences,
             streaming_mlm_batches,
-            text_file_source,
         )
         from dedloc_tpu.data.tokenizer import load_fast_tokenizer
 
@@ -391,8 +399,10 @@ def _make_batches(
             [1.0] * len(args.training.streaming_files)
         )
         seq = min(args.training.seq_length, cfg.max_position_embeddings)
-        return streaming_mlm_batches(
-            [text_file_source(p) for p in args.training.streaming_files],
+        # http(s):// specs stream remotely with retry/resume; the bounded
+        # prefetch overlaps network/tokenization with the training step
+        return prefetch(streaming_mlm_batches(
+            [make_text_source(p) for p in args.training.streaming_files],
             weights,
             lambda doc: [
                 tok.encode_ids(s, add_special_tokens=False)
@@ -404,7 +414,7 @@ def _make_batches(
             seed,
             buffer_size=args.training.streaming_buffer_size,
             max_predictions=max_predictions_for(seq),
-        )
+        ), size=8)
     if not args.training.dataset_path:
         return synthetic_mlm_batches(
             cfg,

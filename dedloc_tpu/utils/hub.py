@@ -31,11 +31,14 @@ _GIT_ID = [
 ]
 
 
-def _git(repo: str, *argv: str) -> str:
+def _git(repo: str, *argv: str, timeout: float = 300.0) -> str:
+    # a hung remote (stalled network during push/fetch) must not wedge the
+    # caller forever — the coordinator's loop depends on this bound
     out = subprocess.run(
         ["git", *_GIT_ID, "-C", repo, *argv],
         capture_output=True,
         text=True,
+        timeout=timeout,
     )
     if out.returncode != 0:
         # surface git's actual stderr — CalledProcessError alone hides it

@@ -495,3 +495,69 @@ def test_solo_collaborative_loop_converges():
     finally:
         opt.shutdown()
         dht.shutdown()
+
+
+def test_aux_bootstraps_template_from_state_provider():
+    """VERDICT r2 item 9: an aux peer joins a live collaboration given ONLY
+    DHT peers — the gradient-shape template comes from a state provider
+    (bootstrap_aux_template), not from caller-supplied model knowledge."""
+    first_dht = DHT(start=True, listen_host="127.0.0.1")
+    aux_dht = DHT(start=True, listen_host="127.0.0.1",
+                  initial_peers=[first_dht.get_visible_address()])
+    tx = lamb(0.05, weight_decay=0.0)
+    trainer_opt = CollaborativeOptimizer(
+        tx, first_dht, "auxboot", **_opt_kwargs(target_batch_size=32,
+                                                averaging_expiration=1.5)
+    )
+    aux_opt = CollaborativeOptimizer(
+        tx, aux_dht, "auxboot", auxiliary=True,
+        **_opt_kwargs(target_batch_size=32, averaging_expiration=1.5),
+    )
+    results = {}
+
+    def trainer():
+        params = {"w": jnp.array([[0.5], [0.5]])}
+        state = TrainState.create(params, tx)
+        acc_fn = make_accumulate_step(_toy_loss)
+        batch = _make_problem(0)
+        grad_acc = zeros_like_grads(params)
+        n_acc = jnp.zeros([], jnp.int32)
+        deadline = time.time() + 90
+        while not results.get("aux_joined") and time.time() < deadline:
+            grad_acc, n_acc, _ = acc_fn(
+                state.params, grad_acc, n_acc, batch, jax.random.PRNGKey(0)
+            )
+            state, grad_acc, n_acc, stepped = trainer_opt.step(
+                state, grad_acc, n_acc, samples=16
+            )
+            if stepped:
+                results["trainer_stepped"] = True
+
+    def aux():
+        template = None
+        deadline = time.time() + 90
+        while template is None and time.time() < deadline:
+            template = aux_opt.bootstrap_aux_template(timeout=5.0)
+            if template is None:
+                time.sleep(0.3)
+        results["template"] = template
+        while (template is not None and not results.get("aux_joined")
+               and time.time() < deadline):
+            if aux_opt.step_aux(template):
+                results["aux_joined"] = True
+            time.sleep(0.2)
+
+    t1 = threading.Thread(target=trainer)
+    t2 = threading.Thread(target=aux)
+    t1.start(); t2.start()
+    t1.join(timeout=120); t2.join(timeout=120)
+    try:
+        assert results.get("trainer_stepped")
+        template = results.get("template")
+        assert template is not None, "bootstrap never found a state provider"
+        assert set(template) == {"['w']"}, template
+        assert template["['w']"].shape == (2, 1)
+        assert results.get("aux_joined"), "bootstrapped aux never joined"
+    finally:
+        trainer_opt.shutdown(); aux_opt.shutdown()
+        aux_dht.shutdown(); first_dht.shutdown()

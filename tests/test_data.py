@@ -255,3 +255,198 @@ def test_prepare_cli_writes_trainable_shards(tmp_path):
     batch = next(batches)
     assert batch["input_ids"].shape == (4, 64)
     assert "mlm_labels" in batch
+
+
+# ---------------------------------------------------------------- HTTP source
+
+
+class _FlakyTextHandler:
+    """http.server handler factory serving text files, optionally dropping
+    every connection after ``fail_after`` bytes (Range-resume exercise)."""
+
+    def __init__(self, files, fail_after=None, support_range=True,
+                 fail_times=None):
+        import http.server
+
+        files_ = files
+        fail_after_ = fail_after
+        support_range_ = support_range
+        fail_times_ = fail_times  # None => drop every connection
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            drops = []
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                body = files_[self.path.lstrip("/")]
+                start = 0
+                rng_header = self.headers.get("Range")
+                if rng_header and support_range_:
+                    start = int(rng_header.split("=")[1].rstrip("-"))
+                    self.send_response(206)
+                else:
+                    self.send_response(200)
+                payload = body[start:]
+                truncated = (
+                    fail_after_ is not None
+                    and len(payload) > fail_after_
+                    and (fail_times_ is None
+                         or len(Handler.drops) < fail_times_)
+                )
+                if truncated:
+                    payload = payload[:fail_after_]
+                    Handler.drops.append(start)
+                    # advertise the FULL length, then close early: the
+                    # client sees a mid-stream connection loss
+                    self.send_header(
+                        "Content-Length", str(len(body) - start)
+                    )
+                else:
+                    self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                try:
+                    self.wfile.write(payload)
+                    if truncated:
+                        self.wfile.flush()
+                        self.connection.close()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+        self.handler = Handler
+
+
+def _http_fixture(files, **kw):
+    import http.server
+    import threading
+
+    factory = _FlakyTextHandler(files, **kw)
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), factory.handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, factory.handler
+
+
+def test_http_text_source_streams_lines():
+    from dedloc_tpu.data.streaming import http_text_source
+
+    lines = [f"document number {i} with words" for i in range(50)]
+    body = ("\n".join(lines) + "\n").encode()
+    server, _ = _http_fixture({"wiki.txt": body})
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}/wiki.txt"
+        got = list(http_text_source(url)())
+        assert got == lines
+    finally:
+        server.shutdown()
+
+
+def test_http_text_source_resumes_after_midstream_drops_exactly_once():
+    """The Range-resume path: the server drops EVERY connection after 256
+    bytes, so the reader must reconnect many times — each line still arrives
+    exactly once, in order (no loss, no duplication)."""
+    from dedloc_tpu.data.streaming import http_text_source
+
+    lines = [f"doc {i} " + "x" * (17 + i % 31) for i in range(120)]
+    body = ("\n".join(lines) + "\n").encode()
+    server, handler = _http_fixture({"oscar.txt": body}, fail_after=256)
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}/oscar.txt"
+        got = list(http_text_source(url, backoff=0.01)())
+        assert got == lines
+        assert len(handler.drops) > 5, "fixture never dropped a connection"
+        # later reconnects actually used Range offsets, not restarts
+        assert any(offset > 0 for offset in handler.drops)
+    finally:
+        server.shutdown()
+
+
+def test_http_text_source_without_range_support_skips_prefix():
+    from dedloc_tpu.data.streaming import http_text_source
+
+    lines = [f"line {i}" for i in range(80)]
+    body = ("\n".join(lines) + "\n").encode()
+    # a server that ignores Range AND always truncates can never make
+    # progress past fail_after; real no-Range servers fail transiently, so
+    # the fixture drops only the first two connections
+    server, _ = _http_fixture(
+        {"t.txt": body}, fail_after=128, support_range=False, fail_times=2
+    )
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}/t.txt"
+        got = list(http_text_source(url, backoff=0.01)())
+        assert got == lines
+    finally:
+        server.shutdown()
+
+
+def test_streaming_mix_over_http(tmp_path):
+    """VERDICT r2 item 6 done-criterion: the weighted wiki/oscar-style mix
+    streams over localhost HTTP end-to-end into trainable MLM batches."""
+    from dedloc_tpu.data.mlm import SpecialTokens
+    from dedloc_tpu.data.streaming import (
+        http_text_source,
+        prefetch,
+        streaming_mlm_batches,
+    )
+
+    wiki = "\n".join(
+        f"wiki article {i}. encyclopedic sentence two. third one here."
+        for i in range(40)
+    ).encode()
+    oscar = "\n".join(
+        f"oscar crawl {i}. noisy web text follows. more of it."
+        for i in range(40)
+    ).encode()
+    server, _ = _http_fixture({"wiki.txt": wiki, "oscar.txt": oscar})
+    try:
+        port = server.server_address[1]
+        tokens = SpecialTokens(
+            cls_id=1, sep_id=2, pad_id=0, mask_id=3, vocab_size=512
+        )
+
+        def tokenize(doc):
+            return [
+                [5 + (hash(w) % 500) for w in s.split()]
+                for s in doc.split(".")
+                if s.strip()
+            ]
+
+        stream = prefetch(
+            streaming_mlm_batches(
+                [
+                    http_text_source(f"http://127.0.0.1:{port}/wiki.txt"),
+                    http_text_source(f"http://127.0.0.1:{port}/oscar.txt"),
+                ],
+                [0.23, 0.77],
+                tokenize,
+                tokens,
+                batch_size=4,
+                max_seq_length=32,
+                seed=7,
+                buffer_size=16,
+                max_predictions=5,
+            ),
+            size=4,
+        )
+        batches = [next(stream) for _ in range(3)]
+        for b in batches:
+            assert b["input_ids"].shape == (4, 32)
+            assert b["mlm_positions"].shape == (4, 5)
+    finally:
+        server.shutdown()
+
+
+def test_prefetch_reraises_and_bounds():
+    from dedloc_tpu.data.streaming import prefetch
+
+    assert list(prefetch(iter(range(10)), size=2)) == list(range(10))
+
+    def boom():
+        yield 1
+        raise ValueError("upstream died")
+
+    it = prefetch(boom(), size=2)
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="upstream died"):
+        list(it)

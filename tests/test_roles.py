@@ -446,3 +446,75 @@ def test_client_mode_trainer_collaborates_via_relay(tmp_path):
         logging.getLogger("dedloc_tpu").removeHandler(capture)
         relay_host.shutdown()
         root_dht.shutdown()
+
+
+def test_join_command_flag_mapping():
+    from dedloc_tpu.join import build_trainer_argv
+
+    argv = build_trainer_argv([
+        "--initial_peers", "10.0.0.1:31337",
+        "--experiment_prefix", "myrun",
+        "--username", "alice", "--credential", "pw",
+        "--client_mode", "--relay", "10.0.0.2:4000",
+        "--training.max_local_steps", "3",
+    ])
+    assert argv[:4] == ["--dht.initial_peers", "10.0.0.1:31337",
+                        "--dht.experiment_prefix", "myrun"]
+    assert "--auth.username" in argv and "--dht.client_mode" in argv
+    assert argv[-2:] == ["--training.max_local_steps", "3"]
+
+
+def test_join_command_verbatim_gated(tmp_path):
+    """VERDICT r2 item 7 done-criterion: the DOCUMENTED one-command join
+    path (python -m dedloc_tpu.join --initial_peers ... --username ...)
+    authorizes against the coordinator's AuthService, joins the DHT, and
+    trains — driven verbatim as a subprocess. A wrong credential fails
+    fast with a clear error."""
+    import subprocess
+    import sys
+
+    from dedloc_tpu.core.auth import AllowlistAuthServer, AuthService
+    from dedloc_tpu.roles.common import build_dht
+
+    root_args = _args(tmp_path)
+    root_dht, _ = build_dht(root_args)
+    auth_server = AllowlistAuthServer({"volunteer": "s3cret"})
+
+    async def _attach(node):
+        AuthService(node.server, auth_server)
+
+    root_dht.run_coroutine(_attach)
+    try:
+        addr = root_dht.get_visible_address()
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        cmd = [
+            sys.executable, "-m", "dedloc_tpu.join",
+            "--initial_peers", addr,
+            "--experiment_prefix", root_args.dht.experiment_prefix,
+            "--username", "volunteer", "--credential", "s3cret",
+            "--batch_size", "2",
+            # tiny-run passthrough so the smoke finishes in seconds
+            "--training.model_size", "tiny",
+            "--training.seq_length", "64",
+            "--training.gradient_accumulation_steps", "2",
+            "--training.max_local_steps", "5",
+            "--training.save_steps", "0",
+            "--optimizer.target_batch_size", "8",
+            "--training.output_dir", str(tmp_path / "vol"),
+        ]
+        out = subprocess.run(
+            cmd, env=env, capture_output=True, text=True, timeout=240,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert "left the collaboration at global step" in out.stdout
+
+        bad = subprocess.run(
+            cmd[:8] + ["wrong"] + cmd[9:], env=env, capture_output=True,
+            text=True, timeout=120,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert bad.returncode != 0
+        assert "not authorized" in (bad.stderr + bad.stdout)
+    finally:
+        root_dht.shutdown()

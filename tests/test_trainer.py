@@ -68,10 +68,19 @@ def test_trainer_collects_perf_stats():
 
 
 def test_swav_role_end_to_end(tmp_path):
+    import logging
+
     from dedloc_tpu.core.config import SwAVCollaborationArguments, parse_config
     from dedloc_tpu.roles.swav import run_swav
     from dedloc_tpu.utils.checkpoint import list_checkpoints
 
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logging.getLogger("dedloc_tpu").addHandler(_Capture())
     args = parse_config(
         SwAVCollaborationArguments,
         [
@@ -96,3 +105,6 @@ def test_swav_role_end_to_end(tmp_path):
     state = run_swav(args)
     assert int(state.step) >= 1, "should have made at least one global step"
     assert list_checkpoints(args.training.output_dir)
+    # the queue path was actually crossed (queue_start_step=1 semantics,
+    # swav_1node_resnet_submit.yaml:95): not just configured, ENGAGED
+    assert any("queue engaged" in m for m in records), records
