@@ -51,7 +51,24 @@ def replicate(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def put_batch(batch, mesh: Mesh, axis: str = "data"):
-    """Device-put a host batch with the batch axis sharded over `axis`."""
+def put_batch(batch, mesh: Mesh, axis: str = "data",
+              seq_axis=None, seq_length=None):
+    """Device-put a host batch with the batch axis sharded over ``axis``.
+
+    With ``seq_axis``/``seq_length`` (sequence-parallel slices), leaves whose
+    second dim is the sequence go straight to P(axis, seq_axis) — the host
+    ships only the S/sp slice per device instead of replicating the full
+    sequence and resharding on-device."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     sharding = shard_batch(mesh, axis)
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    if seq_axis is None:
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    seq_sharding = NamedSharding(mesh, P(axis, seq_axis))
+
+    def _put(x):
+        if x.ndim >= 2 and seq_length and x.shape[1] == seq_length:
+            return jax.device_put(x, seq_sharding)
+        return jax.device_put(x, sharding)
+
+    return jax.tree.map(_put, batch)

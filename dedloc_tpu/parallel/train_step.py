@@ -56,6 +56,8 @@ def zeros_like_grads(params):
 def make_accumulate_step(
     loss_fn: LossFn,
     mesh: Optional[Mesh] = None,
+    seq_axis: Optional[str] = None,
+    seq_length: Optional[int] = None,
 ) -> Callable:
     """Build jitted (params, grad_acc, n_acc, batch, rng) -> (grad_acc', n_acc', metrics).
 
@@ -63,9 +65,27 @@ def make_accumulate_step(
     counts micro-batches so the caller can normalize before averaging/apply.
     The accumulator is donated: it lives in device memory across calls, so the
     host<->device traffic per micro-batch is just the batch itself.
+
+    ``seq_axis``/``seq_length``: for sequence-parallel (ring-attention)
+    meshes, batch leaves whose second dim is the sequence get constrained to
+    P("data", seq_axis) at step entry, so inter-layer activations PROPAGATE
+    seq-sharded and ring attention's in_specs match with zero per-layer
+    relayout — and non-attention activations are S/n per device, the full
+    O(S/n) memory win, not just the score matrix's.
     """
 
     def step(params, grad_acc, n_acc, batch, rng):
+        if mesh is not None and seq_axis is not None:
+            def _constrain(x):
+                if x.ndim >= 2 and seq_length and x.shape[1] == seq_length:
+                    spec = P("data", seq_axis)
+                else:
+                    spec = P("data")
+                return jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, spec)
+                )
+
+            batch = jax.tree.map(_constrain, batch)
         (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, batch, rng
         )
@@ -77,7 +97,11 @@ def make_accumulate_step(
     kwargs = dict(donate_argnums=(1, 2))
     if mesh is not None:
         repl = NamedSharding(mesh, P())
-        data = NamedSharding(mesh, P("data"))
+        # seq-parallel: leave the batch sharding UNSPECIFIED so the per-leaf
+        # layout committed by put_batch (seq dims over seq_axis) flows in
+        # as-is; the in-step constraint above is then a no-op safety net
+        # instead of an every-micro-batch reshard
+        data = None if seq_axis is not None else NamedSharding(mesh, P("data"))
         kwargs.update(
             in_shardings=(repl, repl, repl, data, repl),
             out_shardings=(repl, repl, repl),

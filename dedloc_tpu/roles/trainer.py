@@ -184,7 +184,16 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         )
 
     loss_fn = build_loss_fn(model)
-    accumulate = make_accumulate_step(loss_fn, mesh=mesh)
+    accumulate = make_accumulate_step(
+        loss_fn,
+        mesh=mesh,
+        # sequence-parallel layout: shard batch seq dims over the mesh's
+        # "seq" axis so ring attention sees its expected layout with zero
+        # per-layer relayout (ADVICE r2: activations were full-S per device)
+        seq_axis="seq" if (mesh is not None and "seq" in mesh.axis_names)
+        else None,
+        seq_length=seq,
+    )
     grad_acc = zeros_like_grads(state.params)
     n_acc = jnp.zeros([], jnp.int32)
 
@@ -220,7 +229,11 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
                 batch = drop_collator_keys(next(batches))
                 data_wait += time.perf_counter() - t0
                 if mesh is not None:
-                    batch = put_batch(batch, mesh)
+                    batch = put_batch(
+                        batch, mesh,
+                        seq_axis="seq" if "seq" in mesh.axis_names else None,
+                        seq_length=seq,
+                    )
                 data_rng, sub = jax.random.split(data_rng)
                 grad_acc, n_acc, metrics = accumulate(
                     state.params, grad_acc, n_acc, batch, sub
