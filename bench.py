@@ -217,12 +217,91 @@ def run_swav() -> None:
     print(json.dumps(result))
 
 
+def run_longctx() -> None:
+    """Long-context bench (DEDLOC_BENCH=longctx): ALBERT-large fwd+bwd at
+    S=16,384 on ONE chip via the Pallas flash kernel — the length dense
+    attention cannot even allocate at (BASELINE.md feasibility row, now a
+    reproducible number). Reports tokens/sec; vs_baseline is against the
+    reference's fixed S=512 capability (albert/arguments.py:110): it has NO
+    long-context path, so the anchor is this workload's own S=512 rate and
+    the ratio shows the cost of 32x longer context."""
+    from dedloc_tpu.data.mlm import max_predictions_for
+    from dedloc_tpu.models.albert import (
+        AlbertConfig,
+        AlbertForPreTraining,
+        albert_pretraining_loss_gathered,
+    )
+
+    tiny = os.environ.get("DEDLOC_BENCH_TINY", "") == "1"
+    seq = 1024 if tiny else int(os.environ.get("DEDLOC_BENCH_SEQ", "16384"))
+    per_step = 1
+    impl = "flash" if jax.default_backend() == "tpu" else "dense"
+    if impl == "dense" and seq > 4096:
+        raise SystemExit(
+            "longctx bench off-TPU falls back to dense attention, which "
+            f"cannot allocate S={seq} scores; set DEDLOC_BENCH_TINY=1 or "
+            "DEDLOC_BENCH_SEQ<=4096 for a CPU smoke"
+        )
+    cfg = (AlbertConfig.tiny if tiny else AlbertConfig.large)(
+        remat_policy="dots_no_batch_attn" if impl == "flash" else "dots_no_batch",
+        attention_impl=impl,
+        max_position_embeddings=seq,
+    )
+    max_pred = max_predictions_for(seq)
+    model = AlbertForPreTraining(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((per_step, seq), jnp.int32)
+    )["params"]
+
+    def loss_fn(p, b, r):
+        mlm, sop = model.apply({"params": p}, b["input_ids"],
+                               b["attention_mask"],
+                               mlm_positions=b["mlm_positions"])
+        return albert_pretraining_loss_gathered(
+            mlm, sop, b["mlm_label_ids"], b["mlm_weights"], b["sop_labels"])[0]
+
+    host = np.random.default_rng(0)
+    batch = {
+        "input_ids": jnp.asarray(host.integers(
+            5, cfg.vocab_size, (per_step, seq)).astype(np.int32)),
+        "attention_mask": jnp.ones((per_step, seq), jnp.int32),
+        "mlm_positions": jnp.zeros((per_step, max_pred), jnp.int32),
+        "mlm_label_ids": jnp.zeros((per_step, max_pred), jnp.int32),
+        "mlm_weights": jnp.ones((per_step, max_pred), jnp.float32),
+        "sop_labels": jnp.zeros((per_step,), jnp.int32),
+    }
+    grad = jax.jit(jax.grad(loss_fn))
+    g = grad(params, batch, jax.random.PRNGKey(1))
+    float(jax.tree.leaves(g)[0].ravel()[0])  # settle through the tunnel
+
+    iters = 2 if tiny else 3
+    best = float("inf")
+    for block in range(3):
+        start = time.perf_counter()
+        for _ in range(iters):
+            g = grad(params, batch, jax.random.PRNGKey(2))
+        float(jax.tree.leaves(g)[0].ravel()[0])
+        best = min(best, time.perf_counter() - start)
+    tokens_per_sec = iters * per_step * seq / best
+    # the S=512 recipe sustains 98.3 samples/s x 512 tokens (BASELINE.md)
+    short_ctx_tokens = 98.3 * 512
+    print(json.dumps({
+        "metric": f"albert_large_longctx_s{seq}_fwdbwd_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tokens_per_sec / short_ctx_tokens, 4),
+    }))
+
+
 def main() -> None:
     if os.environ.get("DEDLOC_BENCH") == "codec":
         run_codec()
         return
     if os.environ.get("DEDLOC_BENCH") == "swav":
         run_swav()
+        return
+    if os.environ.get("DEDLOC_BENCH") == "longctx":
+        run_longctx()
         return
     from dedloc_tpu.models.albert import (
         AlbertConfig,

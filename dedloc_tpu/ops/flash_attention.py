@@ -59,7 +59,7 @@ def _t(x):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, gh):
+                acc_ref, m_ref, l_ref, *, scale, gh, packed):
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -98,9 +98,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
 
     @pl.when(kb == nk - 1)
     def _flush():
+        d = q_ref.shape[-1]
         for g in range(gh):
             safe_l = jnp.maximum(l_ref[g], 1e-30)  # [Bq, 1]
-            o_ref[g] = (acc_ref[g] / safe_l).astype(o_ref.dtype)
+            o = (acc_ref[g] / safe_l).astype(o_ref.dtype)
+            if packed:
+                # PAIRED output layout: two D=64 heads share one 128-lane
+                # tile, so the (remat-saved) output has no lane padding in
+                # HBM — half the residual bytes of a [..., 64] layout
+                o_ref[g // 2, :, (g % 2) * d:(g % 2 + 1) * d] = o
+            else:
+                o_ref[g] = o
             lse_ref[g] = _t(m_ref[g] + jnp.log(safe_l))  # -> [1, Bq] row
 
 
@@ -116,13 +124,24 @@ def _pick_heads(bh: int, block_q: int, block_k: int, budget_mb: float = 6.0):
 
 
 def _fwd(q3, k3, v3, bias3, block_q, block_k, interpret):
+    """Returns (out, lse). ``out`` is [BH//2, S, 2D] PAIRED when D < 128 and
+    the head-group size is even (no lane padding in HBM — matters because
+    the remat policy saves this tensor per layer), else [BH, S, D]."""
     bh, s, d = q3.shape
     bq = _pick_block(s, block_q)
     bk = _pick_block(s, block_k)
     gh = _pick_heads(bh, bq, bk)
+    packed = d < 128 and gh % 2 == 0
     scale = 1.0 / (d ** 0.5)
+    if packed:
+        out_spec = pl.BlockSpec((gh // 2, bq, 2 * d),
+                                lambda i, j, kb: (i, j, 0))
+        out_shape = jax.ShapeDtypeStruct((bh // 2, s, 2 * d), q3.dtype)
+    else:
+        out_spec = pl.BlockSpec((gh, bq, d), lambda i, j, kb: (i, j, 0))
+        out_shape = jax.ShapeDtypeStruct((bh, s, d), q3.dtype)
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, gh=gh),
+        functools.partial(_fwd_kernel, scale=scale, gh=gh, packed=packed),
         grid=(bh // gh, s // bq, s // bk),
         in_specs=[
             pl.BlockSpec((gh, bq, d), lambda i, j, kb: (i, j, 0)),
@@ -131,11 +150,11 @@ def _fwd(q3, k3, v3, bias3, block_q, block_k, interpret):
             pl.BlockSpec((gh, 1, bk), lambda i, j, kb: (i, 0, kb)),
         ],
         out_specs=[
-            pl.BlockSpec((gh, bq, d), lambda i, j, kb: (i, j, 0)),
+            out_spec,
             pl.BlockSpec((gh, 1, bq), lambda i, j, kb: (i, 0, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
+            out_shape,
             jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
         scratch_shapes=[
@@ -146,6 +165,15 @@ def _fwd(q3, k3, v3, bias3, block_q, block_k, interpret):
         interpret=interpret,
     )(q3, k3, v3, bias3)
     return out, lse
+
+
+def _unpack_heads(out, bh: int, d: int):
+    """[BH//2, S, 2D] paired -> [BH, S, D] (cheap relayout; inverse pairing
+    of the fwd kernel's flush)."""
+    if out.shape[0] == bh:
+        return out
+    half, s, _ = out.shape
+    return out.reshape(half, s, 2, d).transpose(0, 2, 1, 3).reshape(bh, s, d)
 
 
 # ----------------------------------------------------------------- backward
@@ -268,14 +296,11 @@ def _dqkv_fused_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref,
         ).astype(dk_ref.dtype)
 
 
-def _bwd(q3, k3, v3, bias3, out, lse, do, block_q, block_k, interpret):
+def _bwd(q3, k3, v3, bias3, lse, do, delta, block_q, block_k, interpret):
     bh, s, d = q3.shape
     bq = _pick_block(s, block_q)
     bk = _pick_block(s, block_k)
     scale = 1.0 / (d ** 0.5)
-    delta = jnp.sum(
-        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )[:, None, :]  # [BH, 1, S] row layout (see module docstring)
     if bq == s and bk == s:
         return _bwd_fused(q3, k3, v3, bias3, lse, do, delta, interpret)
     # bwd transients per head are ~3x the fwd's (s, p, dp, ds live at once)
@@ -370,13 +395,31 @@ def _flash(q3, k3, v3, bias3, block_q, block_k, interpret):
 
 
 def _flash_fwd(q3, k3, v3, bias3, block_q, block_k, interpret):
+    # ``out`` may be head-PAIRED [BH//2, S, 2D] (see _fwd): that exact array
+    # is what the dots_no_batch_attn remat policy saves per layer, so the
+    # packed layout halves the residual's HBM footprint at D=64
     out, lse = _fwd(q3, k3, v3, bias3, block_q, block_k, interpret)
     return out, (q3, k3, v3, bias3, out, lse)
 
 
 def _flash_bwd(block_q, block_k, interpret, residuals, g):
     q3, k3, v3, bias3, out, lse = residuals
-    dq, dk, dv = _bwd(q3, k3, v3, bias3, out, lse, g, block_q, block_k,
+    bh, _, d = q3.shape
+    if out.shape[0] != bh:  # paired layout: delta on packed forms, then
+        half = bh // 2      # one cheap permutation for the kernels' do
+        prod = g.astype(jnp.float32) * out.astype(jnp.float32)
+        s_len = prod.shape[1]
+        delta = (
+            prod.reshape(half, s_len, 2, d).sum(-1)
+            .transpose(0, 2, 1).reshape(bh, 1, s_len)
+        )
+        do = _unpack_heads(g, bh, d)
+    else:
+        delta = jnp.sum(
+            g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+        )[:, None, :]  # [BH, 1, S] row layout (see module docstring)
+        do = g
+    dq, dk, dv = _bwd(q3, k3, v3, bias3, lse, do, delta, block_q, block_k,
                       interpret)
     # the mask bias is non-differentiable input
     return dq, dk, dv, jnp.zeros_like(bias3)
@@ -412,4 +455,5 @@ def flash_attention(
             bias[:, None, :], (b, h, s)
         ).reshape(b * h, 1, s).astype(jnp.float32)
     out3 = _flash(to3(q), to3(k), to3(v), bias3, block_q, block_k, interpret)
+    out3 = _unpack_heads(out3, b * h, d)  # paired layout -> [BH, S, D]
     return out3.reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -59,8 +59,6 @@ def put_batch(batch, mesh: Mesh, axis: str = "data",
     second dim is the sequence go straight to P(axis, seq_axis) — the host
     ships only the S/sp slice per device instead of replicating the full
     sequence and resharding on-device."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     sharding = shard_batch(mesh, axis)
     if seq_axis is None:
         return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)

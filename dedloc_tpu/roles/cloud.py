@@ -68,7 +68,7 @@ class Provider(Protocol):
 
     def list_alive(self) -> List[str]: ...
 
-    def delete(self, name: str) -> None: ...
+    def delete(self, name: str, kind: str = "tpu") -> None: ...
 
 
 def _shape_bandwidth_lines(mbps: float) -> List[str]:
@@ -77,10 +77,13 @@ def _shape_bandwidth_lines(mbps: float) -> List[str]:
     if not mbps:
         return []
     rate = int(mbps)
+    # tbf needs burst >= rate/HZ or it caps throughput far below the
+    # nominal rate (HZ can be 100 => 200mbit needs ~250 KB); scale with rate
+    burst_kbit = max(1600, rate * 10)
     return [
         "IFACE=$(ip route show default | awk '{print $5; exit}')",
         f"tc qdisc replace dev $IFACE root tbf rate {rate}mbit "
-        "burst 32kbit latency 400ms",
+        f"burst {burst_kbit}kbit latency 400ms",
     ]
 
 
@@ -143,6 +146,7 @@ class GcloudTPUProvider:
         self.zone = zone
         self.dry_run = dry_run
         self.commands: List[str] = []
+        self.startup_scripts: Dict[str, str] = {}
         self._dry_alive: List[str] = []
 
     def _run(self, argv: List[str]) -> str:
@@ -160,13 +164,25 @@ class GcloudTPUProvider:
 
     def create(self, name: str, kind: str, machine: str,
                startup_script: str, spot: bool) -> None:
+        # the script goes through --metadata-from-file: an inline
+        # --metadata value would need quoting the guest shell must NOT see
+        # (argv exec adds no shell layer to strip it) and commas inside the
+        # script would split metadata entries
+        import tempfile
+
+        script_file = tempfile.NamedTemporaryFile(
+            "w", prefix=f"startup-{name}-", suffix=".sh", delete=False
+        )
+        script_file.write(startup_script)
+        script_file.close()
+        self.startup_scripts[name] = startup_script
         if kind == "tpu":
             argv = [
                 "gcloud", "compute", "tpus", "tpu-vm", "create", name,
                 f"--zone={self.zone}",
                 f"--accelerator-type={machine}",
                 "--version=tpu-ubuntu2204-base",
-                f"--metadata=startup-script={shlex.quote(startup_script)}",
+                f"--metadata-from-file=startup-script={script_file.name}",
             ]
             if spot:
                 argv.append("--spot")
@@ -175,7 +191,7 @@ class GcloudTPUProvider:
                 "gcloud", "compute", "instances", "create", name,
                 f"--zone={self.zone}",
                 f"--machine-type={machine}",
-                f"--metadata=startup-script={shlex.quote(startup_script)}",
+                f"--metadata-from-file=startup-script={script_file.name}",
             ]
             if spot:
                 argv.append("--provisioning-model=SPOT")
@@ -197,11 +213,14 @@ class GcloudTPUProvider:
         ])
         return [n for n in (out + "\n" + out2).splitlines() if n]
 
-    def delete(self, name: str) -> None:
-        self._run([
-            "gcloud", "compute", "tpus", "tpu-vm", "delete", name,
-            f"--zone={self.zone}", "--quiet",
-        ])
+    def delete(self, name: str, kind: str = "tpu") -> None:
+        if kind == "tpu":
+            argv = ["gcloud", "compute", "tpus", "tpu-vm", "delete", name,
+                    f"--zone={self.zone}", "--quiet"]
+        else:
+            argv = ["gcloud", "compute", "instances", "delete", name,
+                    f"--zone={self.zone}", "--quiet"]
+        self._run(argv)
 
 
 def run_cloud_fleet(

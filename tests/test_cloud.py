@@ -22,7 +22,7 @@ class FakeProvider:
     def list_alive(self):
         return list(self.alive)
 
-    def delete(self, name):
+    def delete(self, name, kind="tpu"):
         self.alive.discard(name)
 
 
@@ -91,7 +91,14 @@ def test_gcloud_dry_run_emits_well_formed_commands():
         assert "--zone=us-central2-b" in cmd
         assert "--accelerator-type=v5litepod-1" in cmd
         assert "--spot" in cmd  # preemptible workers (spot semantics)
-        assert "startup-script=" in cmd
+        assert "--metadata-from-file=startup-script=" in cmd
+    # the scripts themselves are raw shell (no quoting layer the guest
+    # shell would choke on) and reachable for inspection
+    worker_scripts = [v for k, v in provider.startup_scripts.items()
+                      if "worker" in k]
+    assert worker_scripts and all(
+        s.startswith("set -e") for s in worker_scripts
+    )
     vm_creates = [c for c in provider.commands
                   if c.startswith("gcloud compute instances create")]
     assert len(vm_creates) == 2  # coordinator + aux

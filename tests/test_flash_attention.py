@@ -126,3 +126,18 @@ def test_flash_rejects_attention_dropout_in_training_only(rng):
             {"params": params}, ids, deterministic=False,
             rngs={"dropout": jax.random.PRNGKey(1)},
         )
+
+
+def test_paired_output_layout_matches_dense(rng):
+    # D=64 with an even head-group triggers the PAIRED [BH//2, S, 2D] output
+    # layout (halves the remat-saved residual's HBM); math must be identical
+    q, k, v = _qkv(rng, b=2, s=128, h=4, d=64)
+    bias = jnp.zeros((2, 128))
+    out = flash_attention(q, k, v, bias)
+    ref = dense_attention(q, k, v, bias)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    gf = jax.grad(
+        lambda q: jnp.sum(flash_attention(q, k, v, bias) ** 2)
+    )(q)
+    gd = jax.grad(lambda q: jnp.sum(dense_attention(q, k, v, bias) ** 2))(q)
+    np.testing.assert_allclose(gf, gd, atol=5e-4, rtol=5e-4)
