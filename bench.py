@@ -283,14 +283,19 @@ def run_longctx() -> None:
         float(jax.tree.leaves(g)[0].ravel()[0])
         best = min(best, time.perf_counter() - start)
     tokens_per_sec = iters * per_step * seq / best
-    # the S=512 recipe sustains 98.3 samples/s x 512 tokens (BASELINE.md)
-    short_ctx_tokens = 98.3 * 512
-    print(json.dumps({
+    result = {
         "metric": f"albert_large_longctx_s{seq}_fwdbwd_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
-        "vs_baseline": round(tokens_per_sec / short_ctx_tokens, 4),
-    }))
+    }
+    if tiny:
+        result["vs_baseline"] = 1.0  # CPU smoke: no meaningful anchor
+    else:
+        # the S=512 recipe sustains 99.45 samples/s x 512 tokens
+        # (BASELINE.md round-3 headline); the ratio is the cost of 32x
+        # longer context under O(S^2) attention FLOPs
+        result["vs_baseline"] = round(tokens_per_sec / (99.45 * 512), 4)
+    print(json.dumps(result))
 
 
 def main() -> None:

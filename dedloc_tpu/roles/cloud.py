@@ -176,13 +176,25 @@ class GcloudTPUProvider:
         script_file.write(startup_script)
         script_file.close()
         self.startup_scripts[name] = startup_script
+        try:
+            self._create_with_script(name, kind, machine, script_file.name,
+                                     spot)
+        finally:
+            import os as _os
+
+            _os.unlink(script_file.name)
+        if self.dry_run:
+            self._dry_alive.append(name)
+
+    def _create_with_script(self, name: str, kind: str, machine: str,
+                            script_path: str, spot: bool) -> None:
         if kind == "tpu":
             argv = [
                 "gcloud", "compute", "tpus", "tpu-vm", "create", name,
                 f"--zone={self.zone}",
                 f"--accelerator-type={machine}",
                 "--version=tpu-ubuntu2204-base",
-                f"--metadata-from-file=startup-script={script_file.name}",
+                f"--metadata-from-file=startup-script={script_path}",
             ]
             if spot:
                 argv.append("--spot")
@@ -191,13 +203,11 @@ class GcloudTPUProvider:
                 "gcloud", "compute", "instances", "create", name,
                 f"--zone={self.zone}",
                 f"--machine-type={machine}",
-                f"--metadata-from-file=startup-script={script_file.name}",
+                f"--metadata-from-file=startup-script={script_path}",
             ]
             if spot:
                 argv.append("--provisioning-model=SPOT")
         self._run(argv)
-        if self.dry_run:
-            self._dry_alive.append(name)
 
     def list_alive(self) -> List[str]:
         if self.dry_run:

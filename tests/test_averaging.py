@@ -1291,3 +1291,53 @@ def test_gated_round_via_relay(rng):
         a1.shutdown(); a2.shutdown(); public.shutdown()
         for d in (d1, d2, root):
             d.shutdown()
+
+
+def test_nat_upgrade_failure_falls_back_to_relay():
+    """A target that cannot complete any direct-path handshake (it serves
+    none of the nat.* coordination methods) must still be reachable: the
+    caller's upgrade attempt fails and the call rides the relay."""
+    from dedloc_tpu.dht.nat import NatTraversal
+    from dedloc_tpu.dht.protocol import (
+        RelayService,
+        RPCClient,
+        RPCServer,
+    )
+
+    async def run():
+        relay_server = RPCServer("127.0.0.1", 0)
+        await relay_server.start()
+        relay_svc = RelayService(relay_server)
+        relay = ("127.0.0.1", relay_server.port)
+
+        # legacy private peer: relay-registered, serves an app method but
+        # NO nat.* handlers (upgrade handshakes fail at the target)
+        legacy = RPCClient(request_timeout=5.0)
+
+        async def echo(_peer, args):
+            return {"echo": args["x"]}
+
+        legacy.reverse_handlers["echo"] = echo
+        ep = await legacy.register_with_relay(relay, b"legacy-peer")
+
+        # caller WITH NAT enabled (private: punch would be attempted)
+        caller = RPCClient(request_timeout=5.0)
+        NatTraversal(caller, None, b"caller-peer", advertised=None,
+                     handshake_timeout=1.0)
+        reply = await caller.call(ep, "echo", {"x": 7}, timeout=10.0)
+        assert reply == {"echo": 7}
+        assert "echo" in relay_svc.piped_methods  # rode the relay
+
+        # failure is cached: the second call must not pay a handshake again
+        before = len([m for m in relay_svc.piped_methods
+                      if m == "nat.punch"])
+        reply = await caller.call(ep, "echo", {"x": 8}, timeout=10.0)
+        assert reply == {"echo": 8}
+        after = len([m for m in relay_svc.piped_methods if m == "nat.punch"])
+        assert after == before, "upgrade re-handshaked despite cool-down"
+
+        await caller.close()
+        await legacy.close()
+        await relay_server.stop()
+
+    asyncio.run(run())

@@ -42,3 +42,21 @@ def test_graft_entry_compiles():
     fn, args = g.entry()
     shapes = jax.eval_shape(fn, *args)
     assert shapes is not None
+
+
+def test_bench_codec_mode_contract():
+    env = dict(os.environ, DEDLOC_BENCH="codec", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py")],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    json_lines = [
+        l for l in out.stdout.strip().splitlines() if l.startswith("{")
+    ]
+    assert len(json_lines) == 1, out.stdout
+    record = json.loads(json_lines[0])
+    assert record["metric"] == "wirecodec_fp16_serialize_ms"
+    assert record["value"] > 0 and record["deserialize_ms"] > 0
+    assert record["n_params"] > 17_000_000  # the real ALBERT-large tree
