@@ -106,6 +106,7 @@ class DecentralizedAverager:
         self.server: Optional[RPCServer] = None
         self.endpoint = None
         self.last_group_size: int = 1
+        self.last_contributors: int = 1
 
         # build server+matchmaking+allreduce on the DHT loop
         def _setup(node):
@@ -240,6 +241,7 @@ class DecentralizedAverager:
                     averaging_expiration=averaging_expiration,
                     authorizer=authorizer,
                     authority_public_key=authority_public_key,
+                    aux=auxiliary,
                 )
 
             return setup()
@@ -284,8 +286,12 @@ class DecentralizedAverager:
             )
         except MatchmakingFailed as e:
             logger.debug(f"matchmaking failed for {round_id}: {e}")
+            self.last_contributors = 0
             return None, 1
         self.last_group_size = len(group.members)
+        # gradient-bearing member count for the caller's divergence guard:
+        # a {trainer, aux} group averages nothing for the trainer
+        self.last_contributors = group.contributors
         if len(group.members) == 1:
             return (tree if weight > 0 else None), 1
         flat, spec = flatten_tree(tree)

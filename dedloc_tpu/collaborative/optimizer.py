@@ -178,6 +178,8 @@ class CollaborativeOptimizer:
         self._desynced = False
         self._round_failures = 0
         self.max_round_retries = 2
+        self._aux_misses = 0
+        self._aux_withheld_at = 0.0
 
     # ------------------------------------------------------------ properties
 
@@ -297,21 +299,31 @@ class CollaborativeOptimizer:
                 round_id=round_id,
                 # tracker's live peer count: full group => assemble the
                 # moment the last partner joins; the straggler window then
-                # only pays off when peers are genuinely late. During cold
+                # only pays off when peers are genuinely late. Aux peers
+                # publish presence records and are counted — without them a
+                # full group assembles the instant the last TRAINER joins
+                # and aux donors systematically lose the race. During cold
                 # start (num_peers <= 1: our own record may be the only
                 # visible one) keep the full window so a concurrent starter
                 # can still pair with us — the design the solo-grace path
                 # above depends on.
                 expected_size=(
-                    collab.num_peers if collab.num_peers >= 2 else None
+                    collab.num_peers + collab.num_aux
+                    if collab.num_peers >= 2 else None
                 ),
             )
-            if averaged is not None and group_size == 1 and collab.num_peers > 1:
-                # we formed a group of one while partners exist: they may be
-                # averaging without us this round, and applying our local
-                # grads now would diverge the replicas. Treat it as a failed
-                # round — the retry keeps the grads; repeated misses fall
-                # back to local-apply + resync below.
+            contributors = getattr(
+                self.averager, "last_contributors", group_size
+            )
+            if (averaged is not None and contributors <= 1
+                    and collab.num_peers > 1):
+                # nobody else CONTRIBUTED gradients while partner trainers
+                # exist — a singleton group, or a group of just us + aux
+                # donors (zero weight): the partners may be averaging
+                # without us this round, and applying our local grads now
+                # would diverge the replicas. Treat it as a failed round —
+                # the retry keeps the grads; repeated misses fall back to
+                # local-apply + resync below.
                 averaged = None
             if averaged is not None:
                 mean_grads = _named_to_tree(averaged, mean_grads)
@@ -515,10 +527,50 @@ class CollaborativeOptimizer:
         }
         return template or None
 
+    # consecutive missed rounds after which an aux stops advertising
+    # presence: a tracker-visible aux that can never actually reach the
+    # averaging groups (e.g. NAT-blocked from every leader) must not make
+    # trainers hold the straggler window open for it on every round
+    aux_presence_miss_limit = 2
+
+    def _report_aux_presence(self) -> None:
+        """Publish a zero-progress presence record so trainers' group
+        sizing counts this aux as an expected averaging participant.
+
+        Withheld after ``aux_presence_miss_limit`` consecutive missed
+        rounds — but only for a cooldown: once presence is withheld,
+        trainers assemble the instant the last trainer joins, which makes
+        winning a round (the other re-advertise trigger) a pure race — a
+        healthy aux that hit a transient blip must not starve forever.
+        After the cooldown it re-advertises and re-probes; a genuinely
+        unreachable aux re-withholds two rounds later.
+
+        The record's ``step`` is 0, not ``local_step``: no current consumer
+        reads an aux record's step (the tracker filters aux records out of
+        the optimizer_step max), and publishing a step that can briefly
+        LEAD the trainers' would send any legacy tracker without the aux
+        filter into a spurious catch-up loop."""
+        if self._aux_misses >= self.aux_presence_miss_limit:
+            cooldown = 4.0 * self.tracker.metadata_expiration
+            if get_dht_time() - self._aux_withheld_at < cooldown:
+                return
+            self._aux_misses = 0
+        self.tracker.report_local_progress(
+            LocalProgress(
+                step=0,
+                samples_accumulated=0,
+                samples_per_second=0.0,
+                time=get_dht_time(),
+                client_mode=False,
+                aux=True,
+            )
+        )
+
     def step_aux(self, template: Dict[str, np.ndarray]) -> bool:
         """Auxiliary peer (run_aux.py:260-263): join the current round with
         zero weight, donating bandwidth. ``template`` gives tensor shapes."""
         assert self.auxiliary
+        self._report_aux_presence()
         collab = self.tracker.fetch_collaboration_state()
         if not collab.ready_for_step:
             return False
@@ -527,9 +579,20 @@ class CollaborativeOptimizer:
         averaged, group_size = self.averager.step(
             zeros, weight=0.0, round_id=round_id
         )
-        self.local_step = collab.optimizer_step + 1
+        ok = averaged is not None
+        if ok:
+            # only a round we actually completed advances our step — a
+            # failed round must leave local_step put so the aux retries the
+            # SAME round (and its presence record doesn't claim progress
+            # it never made)
+            self.local_step = collab.optimizer_step + 1
+            self._aux_misses = 0
+        else:
+            self._aux_misses += 1
+            if self._aux_misses == self.aux_presence_miss_limit:
+                self._aux_withheld_at = get_dht_time()
         self.tracker.fetch_collaboration_state(force=True)
-        return averaged is not None or group_size > 1
+        return ok
 
     def shutdown(self) -> None:
         self._join_backup()

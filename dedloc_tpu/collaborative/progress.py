@@ -27,6 +27,13 @@ class LocalProgress:
     samples_per_second: float
     time: float
     client_mode: bool = False
+    # auxiliary peers (run_aux.py capability) publish PRESENCE records:
+    # they carry no training progress (zero samples, zero throughput) but
+    # let group sizing count the aux as an expected averaging participant —
+    # otherwise a leader using the tracker's peer count assembles the
+    # instant the last TRAINER joins and the aux systematically loses the
+    # race it is there to win
+    aux: bool = False
 
     def pack(self) -> dict:
         return dataclasses.asdict(self)
@@ -39,6 +46,7 @@ class LocalProgress:
             samples_per_second=float(d["samples_per_second"]),
             time=float(d["time"]),
             client_mode=bool(d.get("client_mode", False)),
+            aux=bool(d.get("aux", False)),
         )
 
 
@@ -47,10 +55,11 @@ class CollaborationState:
     optimizer_step: int
     samples_accumulated: int  # collaboration-wide, towards the NEXT step
     target_batch_size: int
-    num_peers: int
+    num_peers: int  # trainers only — aux presence is counted separately
     num_clients: int
     eta_next_step: float  # seconds
     next_fetch_time: float  # dht time
+    num_aux: int = 0  # live aux peers expected to join averaging rounds
     # start the round this many samples EARLY so matchmaking latency
     # overlaps the tail of accumulation (the reference's batch_size_lead,
     # albert/arguments.py CollaborativeOptimizerArguments)
@@ -143,7 +152,13 @@ class ProgressTracker:
             if stored is None or stored.time <= self._last_local.time:
                 by_subkey[self.peer_subkey] = self._last_local
 
-        records = list(by_subkey.values())
+        # aux presence records carry no training progress — they must not
+        # drive optimizer_step (an aux's step can lead trainers briefly
+        # around a round boundary, and letting it win the max would make
+        # every trainer think it fell behind) nor the sample/throughput
+        # totals; they only size averaging groups (num_aux)
+        records = [r for r in by_subkey.values() if not r.aux]
+        num_aux = sum(r.aux for r in by_subkey.values())
         max_step, total_samples, total_sps = 0, 0, 0.0
         num_peers = num_clients = 0
         if records:
@@ -181,6 +196,7 @@ class ProgressTracker:
             target_batch_size=self.target_batch_size,
             num_peers=num_peers,
             num_clients=num_clients,
+            num_aux=num_aux,
             eta_next_step=eta,
             next_fetch_time=self._next_fetch,
             batch_size_lead=self.batch_size_lead,

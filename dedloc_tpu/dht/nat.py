@@ -46,6 +46,7 @@ from dedloc_tpu.dht.protocol import (
     Endpoint,
     RPCClient,
     RPCServer,
+    probe_route_alive,
     relay_endpoint,
 )
 from dedloc_tpu.utils.logging import get_logger
@@ -90,6 +91,7 @@ class NatTraversal:
         self._expected: Dict[str, float] = {}
         self._failed: Dict[str, float] = {}
         self._locks: Dict[str, asyncio.Lock] = {}
+        self._register_locks: Dict[str, asyncio.Lock] = {}
 
         if server is not None and server.port is not None:
             # listening (public) side: accept solicited dial-backs
@@ -110,6 +112,18 @@ class NatTraversal:
             self._routes.pop(peer_hex, None)
             return None
         return w
+
+    def drop_route(self, peer_hex: str) -> None:
+        """Evict a reversal route whose connection failed in use (timeout on
+        ``call_over``): is_closing() never fires on a half-open TCP path, so
+        the caller's failure signal is the only eviction trigger. The next
+        call to this peer rides the relay and re-solicits a dial-back."""
+        w = self._routes.pop(peer_hex, None)
+        if w is not None:
+            try:
+                w.close()
+            except OSError:
+                pass
 
     async def upgrade(
         self, relay: Endpoint, peer_hex: str
@@ -174,22 +188,57 @@ class NatTraversal:
             raise PermissionError(
                 f"unsolicited nat registration for {peer_hex[:12]!r}"
             )
-        current = self._routes.get(peer_hex)
-        if (current is not None and current is not writer
-                and not current.is_closing()):
-            raise PermissionError(
-                f"peer {peer_hex[:12]!r} already has a live route"
-            )
-        self._routes[peer_hex] = writer
+        # per-peer REGISTRATION lock (distinct from the upgrade locks:
+        # _reverse holds those while awaiting the very dial-back served
+        # here, so sharing them would deadlock): the liveness probe below
+        # awaits, and two dial-backs from overlapping solicitations must not
+        # interleave their check-then-replace (the slower one would clobber
+        # the fresh route with an abandoned writer)
+        lock = self._register_locks.setdefault(peer_hex, asyncio.Lock())
+        async with lock:
+            current = self._routes.get(peer_hex)
+            if (current is not None and current is not writer
+                    and not current.is_closing()):
+                # a half-open old route (peer power loss, NAT mapping expiry
+                # — no FIN, is_closing() stays False forever) must not block
+                # the peer's legitimate re-dial: probe the old path
+                # end-to-end and only refuse the newcomer when it still
+                # answers (same contract as the relay's check)
+                if await probe_route_alive(self.server, current, "nat.hello"):
+                    raise PermissionError(
+                        f"peer {peer_hex[:12]!r} already has a live route"
+                    )
+                self.drop_route(peer_hex)
+            self._routes[peer_hex] = writer
         return {"registered": True}
 
     async def _rpc_reverse_connect(self, _ep: Endpoint, args) -> dict:
-        dial = (args["dial"][0], int(args["dial"][1]))
         # dialing back parks OUR pooled connection at the public peer; its
         # calls then arrive on it and dispatch via reverse_handlers
-        await self.client.call(
-            dial, "nat.register", {"peer_id": self.peer_id.hex()}
-        )
+        dial = (args["dial"][0], int(args["dial"][1]))
+        reg = {"peer_id": self.peer_id.hex()}
+        if dial in self.client._conns:
+            # an existing pooled connection to the solicitor may be the
+            # dead half of the very path being re-solicited (symmetric
+            # half-open death never EOFs) — but it may also be a healthy
+            # shared connection (e.g. our relay registration, when the
+            # solicitor IS our relay), so never evict blindly: try the
+            # register over it with a bounded budget, and only on silence
+            # evict and dial fresh
+            try:
+                await self.client.call(
+                    dial, "nat.register", reg,
+                    timeout=max(1.0, self.handshake_timeout / 2),
+                )
+                logger.info(
+                    f"nat: dialed back to {dial} (connection reversal)"
+                )
+                return {"dialed": True}
+            except (asyncio.TimeoutError, ConnectionError, OSError):
+                self.client._drop(
+                    dial, ConnectionResetError("re-dial solicited")
+                )
+        await self.client.call(dial, "nat.register", reg)
         logger.info(f"nat: dialed back to {dial} (connection reversal)")
         return {"dialed": True}
 
@@ -300,6 +349,12 @@ class NatTraversal:
                 except (OSError, asyncio.TimeoutError):
                     s.close()
                     await asyncio.sleep(0.08)
+                except asyncio.CancelledError:
+                    # cancelled mid sock_connect: the in-flight socket is
+                    # ours to close — repeated punches on a long-lived peer
+                    # must not accumulate leaked FDs
+                    s.close()
+                    raise
 
         tasks = [asyncio.ensure_future(_accept()),
                  asyncio.ensure_future(_dial())]

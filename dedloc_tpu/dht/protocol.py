@@ -330,11 +330,30 @@ class RPCClient:
                     if writer is not None and self.nat.server is not None:
                         # reversal route: the target dialed us back; call it
                         # over the parked inbound connection
-                        return await self.nat.server.call_over(
-                            writer, method, args or {},
-                            timeout=timeout or self.request_timeout,
-                        )
-                    route = None
+                        try:
+                            return await self.nat.server.call_over(
+                                writer, method, args or {},
+                                timeout=timeout or self.request_timeout,
+                            )
+                        except RPCError:
+                            raise  # remote answered — the route is alive
+                        except asyncio.TimeoutError:
+                            # half-open reversal route (NAT mapping expiry,
+                            # silent TCP death — is_closing() never fires):
+                            # evict it so the NEXT call rides the relay and
+                            # re-solicits a dial-back. The timeout budget is
+                            # already spent, so retrying inline would make a
+                            # timeout=T call take ~2T — callers' straggler
+                            # deadlines must stay honest.
+                            self.nat.drop_route(peer_hex)
+                            raise
+                        except (ConnectionError, OSError):
+                            # instant transport failure (no budget burned):
+                            # evict and fall back to the relay inline
+                            self.nat.drop_route(peer_hex)
+                            route = None
+                    else:
+                        route = None
             if route != "conn":
                 inner_timeout = timeout or self.request_timeout
                 return await self.call(
@@ -374,6 +393,25 @@ class RPCClient:
 
 class RPCError(Exception):
     pass
+
+
+async def probe_route_alive(
+    server: RPCServer,
+    writer: asyncio.StreamWriter,
+    method: str,
+    timeout: float = 2.0,
+) -> bool:
+    """End-to-end liveness probe of a parked inbound connection. A half-open
+    TCP path (peer power loss, NAT mapping expiry with no FIN — is_closing()
+    stays False forever) only reveals itself by not answering; True means
+    the peer at the other end actually replied. Shared by the relay's and
+    the NAT layer's re-registration checks so their hijack-protection
+    semantics cannot drift apart."""
+    try:
+        await server.call_over(writer, method, {}, timeout=timeout)
+        return True
+    except Exception:  # noqa: BLE001 — no answer == dead path
+        return False
 
 
 # NAT-coordination methods must not themselves trigger an upgrade attempt
@@ -416,22 +454,14 @@ class RelayService:
             # Never silently overwrite a registration whose connection still
             # ANSWERS: otherwise any host that can reach the relay could
             # hijack another peer's virtual endpoint and receive its
-            # matchmaking/allreduce traffic. A half-open old connection
-            # (NAT mapping expired, no FIN — is_closing() stays False
-            # forever) must not block the legitimate re-registration the
-            # keepalive performs, so the OLD path is probed: alive => the
-            # newcomer is refused; dead/unresponsive => replaced.
-            try:
-                await self.server.call_over(
-                    current, "relay.probe", {}, timeout=2.0
-                )
+            # matchmaking/allreduce traffic. A half-open old connection must
+            # not block the legitimate re-registration the keepalive
+            # performs, so the OLD path is probed: alive => the newcomer is
+            # refused; dead/unresponsive => replaced.
+            if await probe_route_alive(self.server, current, "relay.probe"):
                 raise PermissionError(
                     f"peer {peer_id!r} already has a live registration"
                 )
-            except PermissionError:
-                raise
-            except Exception:  # noqa: BLE001 — old path dead: replace it
-                pass
         self._registered[peer_id] = writer
         return {"registered": True}
 

@@ -54,10 +54,52 @@ class CloudFleetSpec:
     coordinator_port: int = 31337
     # gated runs: "user:cred,..." hosted by the coordinator's AuthService
     auth_allowlist: str = ""
+    # gated runs: the fleet's OWN peers need credentials too — otherwise
+    # signed volunteer leaders reject the fleet's unsigned joins and
+    # matchmaking partitions into signed/unsigned subsets. A per-fleet
+    # credential is auto-generated (or supplied) and appended to the
+    # coordinator's allowlist; worker/aux startup scripts join with it.
+    # NOTE: supply fleet_credential explicitly when re-running a supervisor
+    # against an already-provisioned coordinator — a fresh auto-generated
+    # value is unknown to the live coordinator's allowlist, so respawned
+    # workers would be rejected.
+    fleet_username: str = "fleet"
+    fleet_credential: str = ""
     # software setup prefix (image/venv activation) prepended to every
     # startup script; deployments point this at their image's environment
     setup_lines: Sequence[str] = ("set -e",)
     repo_dir: str = "/opt/dedloc_tpu"
+
+    def __post_init__(self) -> None:
+        if self.auth_allowlist:
+            operators = {
+                pair.split(":", 1)[0]
+                for pair in self.auth_allowlist.split(",") if pair
+            }
+            if self.fleet_username in operators:
+                # the coordinator lowers the allowlist into a dict, so the
+                # appended fleet entry would silently override the
+                # operator's user of the same name (locking those
+                # volunteers out) — refuse the ambiguity at spec time
+                raise ValueError(
+                    f"auth_allowlist already contains user "
+                    f"{self.fleet_username!r}; rename it or set "
+                    f"fleet_username to something else"
+                )
+            if not self.fleet_credential:
+                import secrets
+
+                self.fleet_credential = secrets.token_hex(16)
+
+    @property
+    def full_allowlist(self) -> str:
+        """Operator allowlist plus the fleet's own credential."""
+        if not self.auth_allowlist:
+            return ""
+        return (
+            f"{self.auth_allowlist},"
+            f"{self.fleet_username}:{self.fleet_credential}"
+        )
 
 
 class Provider(Protocol):
@@ -96,7 +138,7 @@ def coordinator_startup(spec: CloudFleetSpec) -> str:
             f"--dht.listen_port {spec.coordinator_port}",
             "--coordinator.upload_interval 3600",
         ] + (
-            [f"--coordinator.auth_allowlist {shlex.quote(spec.auth_allowlist)}"]
+            [f"--coordinator.auth_allowlist {shlex.quote(spec.full_allowlist)}"]
             if spec.auth_allowlist else []
         )),
     ]
@@ -117,7 +159,13 @@ def worker_startup(spec: CloudFleetSpec, idx: int,
             "python -m dedloc_tpu.join",
             f"--initial_peers {coordinator_host}:{spec.coordinator_port}",
             f"--experiment_prefix {shlex.quote(spec.experiment_prefix)}",
-        ] + ([f"--bandwidth {tier}", f"--training.seed {idx}"]
+        ] + (
+            # gated fleet: join with the fleet credential (the AuthService
+            # rides the coordinator's DHT port, join.py's default endpoint)
+            [f"--username {shlex.quote(spec.fleet_username)}",
+             f"--credential {shlex.quote(spec.fleet_credential)}"]
+            if spec.auth_allowlist else []
+        ) + ([f"--bandwidth {tier}", f"--training.seed {idx}"]
              if tier else [f"--training.seed {idx}"])),
     ]
     return "\n".join(lines)
@@ -131,7 +179,11 @@ def aux_startup(spec: CloudFleetSpec, coordinator_host: str) -> str:
             "--dht.initial_peers "
             f"{coordinator_host}:{spec.coordinator_port}",
             f"--dht.experiment_prefix {shlex.quote(spec.experiment_prefix)}",
-        ]),
+        ] + (
+            [f"--auth.username {shlex.quote(spec.fleet_username)}",
+             f"--auth.credential {shlex.quote(spec.fleet_credential)}"]
+            if spec.auth_allowlist else []
+        )),
     ]
     return "\n".join(lines)
 

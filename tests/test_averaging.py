@@ -1341,3 +1341,252 @@ def test_nat_upgrade_failure_falls_back_to_relay():
         await relay_server.stop()
 
     asyncio.run(run())
+
+
+def test_reversal_route_halfopen_recovers_via_relay():
+    """ADVICE r3: a reversal route that dies silently (no FIN — the target
+    stops reading but the socket stays open) must not wedge the caller: the
+    timed-out call_over evicts the route (and surfaces the timeout — its
+    budget is spent), and the NEXT call reaches the target via the relay."""
+    from dedloc_tpu.dht.nat import NatTraversal
+    from dedloc_tpu.dht.protocol import (
+        RelayService,
+        RPCClient,
+        RPCServer,
+    )
+
+    async def run():
+        relay_server = RPCServer("127.0.0.1", 0)
+        await relay_server.start()
+        relay_svc = RelayService(relay_server)
+        relay = ("127.0.0.1", relay_server.port)
+
+        # private target: relay-registered, serves echo + nat.* handlers
+        target = RPCClient(request_timeout=5.0)
+
+        async def echo(_peer, args):
+            return {"echo": args["x"]}
+
+        target.reverse_handlers["echo"] = echo
+        ep = await target.register_with_relay(relay, b"target-peer")
+        target_nat = NatTraversal(target, None, b"target-peer",
+                                  advertised=None)
+
+        # public caller: advertised endpoint => reversal path
+        caller_server = RPCServer("127.0.0.1", 0)
+        await caller_server.start()
+        caller = RPCClient(request_timeout=5.0)
+        caller_nat = NatTraversal(
+            caller, caller_server, b"caller-peer",
+            advertised=("127.0.0.1", caller_server.port),
+            handshake_timeout=2.0,
+        )
+
+        reply = await caller.call(ep, "echo", {"x": 1}, timeout=10.0)
+        assert reply == {"echo": 1}
+        peer_hex = b"target-peer".hex()
+        assert caller_nat.direct_writer(peer_hex) is not None, (
+            "expected a parked reversal route"
+        )
+
+        # silent half-open: swap the parked route for a connection whose
+        # far end never reads or answers — the writer reports open, so
+        # only the in-use failure signal can evict it
+        _raw_r, raw_w = await asyncio.open_connection(
+            "127.0.0.1", caller_server.port
+        )
+        await asyncio.sleep(0.1)
+        live_writer = caller_nat._routes[peer_hex]
+        dead_writer = next(
+            w for w in caller_server._writers if w is not live_writer
+        )
+        caller_nat._routes[peer_hex] = dead_writer
+
+        # the in-flight call surfaces its timeout (budget spent — retrying
+        # inline would double the caller's deadline) but EVICTS the route
+        with pytest.raises((asyncio.TimeoutError, TimeoutError)):
+            await caller.call(ep, "echo", {"x": 2}, timeout=1.0)
+        assert caller_nat._routes.get(peer_hex) is not dead_writer, (
+            "dead reversal route must be evicted"
+        )
+
+        # next call: a fresh dial-back is re-solicited through the relay —
+        # and nat.register's liveness probe must replace (not refuse) any
+        # half-open leftover — so the caller reaches the target again
+        reply = await caller.call(ep, "echo", {"x": 3}, timeout=15.0)
+        assert reply == {"echo": 3}, "caller must recover after route death"
+        assert "nat.reverse_connect" in relay_svc.piped_methods
+        raw_w.close()
+
+        await caller.close()
+        await target.close()
+        await caller_server.stop()
+        await relay_server.stop()
+
+    asyncio.run(run())
+
+
+def test_nat_register_probes_halfopen_route_before_refusing():
+    """ADVICE r3 (mirror of RelayService's relay.probe): a half-open old
+    reversal route must not block the peer's legitimate re-dial — the
+    server probes the old path with nat.hello and only refuses when it
+    still answers."""
+    from dedloc_tpu.dht.nat import NatTraversal
+    from dedloc_tpu.dht.protocol import (
+        RPCClient,
+        RPCServer,
+        read_frame,
+        write_frame,
+    )
+
+    async def run():
+        server = RPCServer("127.0.0.1", 0)
+        await server.start()
+        client = RPCClient(request_timeout=5.0)
+        nat = NatTraversal(
+            client, server, b"public-peer",
+            advertised=("127.0.0.1", server.port),
+        )
+        peer_hex = b"nat-peer".hex()
+        import time as _time
+
+        async def register(reader, writer, rid):
+            write_frame(writer, {
+                "id": rid, "method": "nat.register",
+                "args": {"peer_id": peer_hex},
+            })
+            await writer.drain()
+            return await asyncio.wait_for(read_frame(reader), timeout=10.0)
+
+        # first route: registers, then goes silent (never answers probes)
+        nat._expected[peer_hex] = _time.monotonic()
+        r1, w1 = await asyncio.open_connection("127.0.0.1", server.port)
+        reply = await register(r1, w1, 1)
+        assert reply["ok"], reply
+
+        # second route from the same peer (post NAT-expiry re-dial): the
+        # probe of the silent old route times out => replaced, not refused
+        nat._expected[peer_hex] = _time.monotonic()
+        r2, w2 = await asyncio.open_connection("127.0.0.1", server.port)
+        t0 = _time.monotonic()
+        reply = await register(r2, w2, 2)
+        assert reply["ok"], f"half-open route must be replaced: {reply}"
+        assert _time.monotonic() - t0 >= 1.0, "expected a probe attempt"
+
+        # keep the live route ANSWERING nat.hello: a third registration
+        # must now be refused (hijack protection intact)
+        async def answer_hellos():
+            while True:
+                msg = await read_frame(r2)
+                if msg.get("method") == "nat.hello":
+                    write_frame(w2, {"id": msg["id"], "ok": True,
+                                     "result": {"peer_id": peer_hex}})
+                    await w2.drain()
+
+        answering = asyncio.ensure_future(answer_hellos())
+        nat._expected[peer_hex] = _time.monotonic()
+        r3, w3 = await asyncio.open_connection("127.0.0.1", server.port)
+        reply = await register(r3, w3, 3)
+        assert not reply["ok"] and "live route" in reply["error"], reply
+        answering.cancel()
+
+        for w in (w1, w2, w3):
+            w.close()
+        await client.close()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_reversal_symmetric_halfopen_reestablishes_direct_route():
+    """Symmetric route death (a real NAT mapping expiry kills BOTH
+    directions silently): the caller evicts its side on timeout, and the
+    target must evict its own dead pooled connection when re-solicited —
+    otherwise the re-dial rides the dead socket and the direct path never
+    comes back."""
+    from dedloc_tpu.dht.nat import NatTraversal
+    from dedloc_tpu.dht.protocol import (
+        RelayService,
+        RPCClient,
+        RPCServer,
+    )
+
+    async def run():
+        relay_server = RPCServer("127.0.0.1", 0)
+        await relay_server.start()
+        relay_svc = RelayService(relay_server)
+        relay = ("127.0.0.1", relay_server.port)
+
+        target = RPCClient(request_timeout=3.0)
+
+        async def echo(_peer, args):
+            return {"echo": args["x"]}
+
+        target.reverse_handlers["echo"] = echo
+        ep = await target.register_with_relay(relay, b"target-peer")
+        NatTraversal(target, None, b"target-peer", advertised=None)
+
+        caller_server = RPCServer("127.0.0.1", 0)
+        await caller_server.start()
+        caller = RPCClient(request_timeout=5.0)
+        caller_nat = NatTraversal(
+            caller, caller_server, b"caller-peer",
+            advertised=("127.0.0.1", caller_server.port),
+            handshake_timeout=4.0,
+        )
+
+        reply = await caller.call(ep, "echo", {"x": 1}, timeout=10.0)
+        assert reply == {"echo": 1}
+        peer_hex = b"target-peer".hex()
+        dial_ep = ("127.0.0.1", caller_server.port)
+        assert dial_ep in target._conns
+
+        # poison the CALLER side: a parked connection whose far end never
+        # answers stands in for the dead inbound half
+        _raw_r, raw_w = await asyncio.open_connection(*dial_ep)
+        await asyncio.sleep(0.1)
+        live_writer = caller_nat._routes[peer_hex]
+        dead_writer = next(
+            w for w in caller_server._writers if w is not live_writer
+        )
+        caller_nat._routes[peer_hex] = dead_writer
+
+        # poison the TARGET side: its pooled connection to the caller is
+        # replaced by one to a black hole (open, never answers) — the dead
+        # outbound half of the same path
+        async def _blackhole(_r, _w):
+            await asyncio.sleep(3600)
+
+        hole = await asyncio.start_server(_blackhole, "127.0.0.1", 0)
+        hr, hw = await asyncio.open_connection(
+            "127.0.0.1", hole.sockets[0].getsockname()[1]
+        )
+        target._readers[dial_ep].cancel()
+        await asyncio.sleep(0.05)
+        target._conns[dial_ep] = (hr, hw)
+        target._pending[dial_ep] = {}
+
+        with pytest.raises((asyncio.TimeoutError, TimeoutError)):
+            await caller.call(ep, "echo", {"x": 2}, timeout=1.0)
+        assert caller_nat._routes.get(peer_hex) is not dead_writer
+
+        # re-solicitation: the target must evict its dead pooled conn and
+        # dial back FRESH — the direct route comes back, no relay data path
+        reply = await caller.call(ep, "echo", {"x": 3}, timeout=15.0)
+        assert reply == {"echo": 3}
+        assert caller_nat.direct_writer(peer_hex) is not None, (
+            "direct reversal route must be re-established after symmetric "
+            "half-open death"
+        )
+        assert "echo" not in relay_svc.piped_methods, (
+            "tensor-path methods must not ride the relay after recovery"
+        )
+
+        raw_w.close(); hw.close()
+        hole.close()
+        await caller.close()
+        await target.close()
+        await caller_server.stop()
+        await relay_server.stop()
+
+    asyncio.run(run())

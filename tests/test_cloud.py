@@ -103,3 +103,25 @@ def test_gcloud_dry_run_emits_well_formed_commands():
                   if c.startswith("gcloud compute instances create")]
     assert len(vm_creates) == 2  # coordinator + aux
     assert all("SPOT" not in c for c in vm_creates)
+
+
+def test_gated_fleet_wires_credentials_into_all_roles():
+    """ADVICE r3: when the run is gated, the fleet's own workers and aux
+    must join signed — the coordinator's allowlist gains a per-fleet
+    credential and every worker/aux startup script carries it."""
+    spec = CloudFleetSpec(auth_allowlist="alice:pw")
+    assert spec.fleet_credential, "fleet credential must be auto-generated"
+    coord = coordinator_startup(spec)
+    assert f"fleet:{spec.fleet_credential}" in coord
+    assert "alice:pw" in coord
+    worker = worker_startup(spec, 0, "10.0.0.1")
+    assert "--username fleet" in worker
+    assert f"--credential {spec.fleet_credential}" in worker
+    aux = aux_startup(spec, "10.0.0.1")
+    assert "--auth.username fleet" in aux
+    assert f"--auth.credential {spec.fleet_credential}" in aux
+    # open runs stay credential-free
+    open_spec = CloudFleetSpec()
+    assert not open_spec.fleet_credential
+    assert "--username" not in worker_startup(open_spec, 0, "h")
+    assert "--auth.username" not in aux_startup(open_spec, "h")

@@ -561,3 +561,222 @@ def test_aux_bootstraps_template_from_state_provider():
     finally:
         trainer_opt.shutdown(); aux_opt.shutdown()
         aux_dht.shutdown(); first_dht.shutdown()
+
+
+def test_aux_presence_counts_for_sizing_not_progress():
+    """Aux peers publish zero-weight presence records: they size averaging
+    groups (num_aux) but must not drive optimizer_step or sample totals."""
+    from dedloc_tpu.collaborative.progress import (
+        LocalProgress,
+        ProgressTracker,
+    )
+    from dedloc_tpu.core.timeutils import get_dht_time
+
+    dht = DHT(start=True, listen_host="127.0.0.1")
+    try:
+        kw = dict(target_batch_size=64, min_refresh_period=0.05,
+                  default_refresh_period=0.1)
+        trainer = ProgressTracker(dht, "auxp", peer_subkey=b"trainer", **kw)
+        aux = ProgressTracker(dht, "auxp", peer_subkey=b"aux", **kw)
+        trainer.report_local_progress(LocalProgress(
+            step=3, samples_accumulated=10, samples_per_second=5.0,
+            time=get_dht_time(),
+        ))
+        # an aux whose step counter momentarily LEADS the trainers (it
+        # advanced at the end of the last round before the trainers'
+        # records refreshed) — it must not win the optimizer_step max
+        aux.report_local_progress(LocalProgress(
+            step=4, samples_accumulated=0, samples_per_second=0.0,
+            time=get_dht_time(), aux=True,
+        ))
+        deadline = time.time() + 10
+        collab = trainer.fetch_collaboration_state(force=True)
+        while collab.num_aux < 1 and time.time() < deadline:
+            time.sleep(0.1)
+            collab = trainer.fetch_collaboration_state(force=True)
+        assert collab.num_peers == 1, collab
+        assert collab.num_aux == 1, collab
+        assert collab.optimizer_step == 3, "aux step must not lead trainers"
+        assert collab.samples_accumulated == 10
+    finally:
+        dht.shutdown()
+
+
+def test_step_aux_failed_round_keeps_step_and_retries_same_round():
+    """VERDICT r3 #9: an aux whose round fails must NOT advance local_step —
+    it retries the same round and only a completed round claims progress."""
+    from dedloc_tpu.collaborative.progress import CollaborationState
+    from dedloc_tpu.core.timeutils import get_dht_time
+
+    dht = DHT(start=True, listen_host="127.0.0.1")
+    tx = lamb(0.05, weight_decay=0.0)
+    aux_opt = CollaborativeOptimizer(
+        tx, dht, "auxfail", auxiliary=True,
+        **_opt_kwargs(target_batch_size=32),
+    )
+    try:
+        template = {"['w']": np.zeros((2, 1), np.float32)}
+
+        def fake_collab(force=False):
+            return CollaborationState(
+                optimizer_step=7,
+                samples_accumulated=10**9,
+                target_batch_size=32,
+                num_peers=2,
+                num_clients=0,
+                eta_next_step=0.0,
+                next_fetch_time=get_dht_time() + 60.0,
+            )
+
+        aux_opt.tracker.fetch_collaboration_state = fake_collab
+        aux_opt.local_step = 7
+        rounds = []
+
+        def failing_step(zeros, weight, round_id, **kw):
+            rounds.append(round_id)
+            return None, 1  # singleton / failed round
+
+        aux_opt.averager.step = failing_step
+        assert aux_opt.step_aux(template) is False
+        assert aux_opt.local_step == 7, "failed round must not claim progress"
+        assert aux_opt.step_aux(template) is False
+        assert rounds == ["step7", "step7"], "must retry the SAME round"
+
+        # after aux_presence_miss_limit consecutive misses the aux stops
+        # advertising presence (trainers must not hold the straggler window
+        # for an aux that can never join) — but keeps trying to join
+        published = []
+        aux_opt.tracker.report_local_progress = published.append
+        assert aux_opt.step_aux(template) is False
+        assert published == [], "unreachable aux must withhold presence"
+
+        def ok_step(zeros, weight, round_id, **kw):
+            rounds.append(round_id)
+            return dict(zeros), 2
+
+        aux_opt.averager.step = ok_step
+        assert aux_opt.step_aux(template) is True
+        assert aux_opt.local_step == 8
+        assert aux_opt._aux_misses == 0
+        assert aux_opt.step_aux(template) is True
+        assert published, "a successful round must re-advertise presence"
+    finally:
+        aux_opt.shutdown()
+        dht.shutdown()
+
+
+def test_trainer_expected_group_size_includes_aux():
+    """ADVICE r3: group sizing counts aux presence — a leader must keep its
+    straggler window open for the aux instead of assembling the moment the
+    last trainer joins."""
+    from dedloc_tpu.collaborative.progress import CollaborationState
+    from dedloc_tpu.core.timeutils import get_dht_time
+
+    dht = DHT(start=True, listen_host="127.0.0.1")
+    tx = lamb(0.05, weight_decay=0.0)
+    opt = CollaborativeOptimizer(tx, dht, "auxsize", **_opt_kwargs())
+    try:
+        params = {"w": jnp.array([[0.5], [0.5]])}
+        state = TrainState.create(params, tx)
+        acc_fn = make_accumulate_step(_toy_loss)
+        batch = _make_problem(0)
+        grad_acc = zeros_like_grads(params)
+        n_acc = jnp.zeros([], jnp.int32)
+        grad_acc, n_acc, _ = acc_fn(
+            state.params, grad_acc, n_acc, batch, jax.random.PRNGKey(0)
+        )
+
+        def fake_collab(force=False):
+            return CollaborationState(
+                optimizer_step=opt.local_step,
+                samples_accumulated=10**9,
+                target_batch_size=64,
+                num_peers=2,
+                num_clients=0,
+                num_aux=1,
+                eta_next_step=0.0,
+                next_fetch_time=get_dht_time() + 60.0,
+            )
+
+        opt.tracker.fetch_collaboration_state = fake_collab
+        seen = {}
+
+        def fake_avg_step(named, weight, round_id, expected_size=None, **kw):
+            seen["expected_size"] = expected_size
+            opt.averager.last_contributors = 2  # both trainers contributed
+            return named, 3
+
+        opt.averager.step = fake_avg_step
+        state, grad_acc, n_acc, stepped = opt.step(
+            state, grad_acc, n_acc, samples=16
+        )
+        assert stepped
+        assert seen["expected_size"] == 3, (
+            "expected_size must count 2 trainers + 1 aux"
+        )
+    finally:
+        opt.shutdown()
+        dht.shutdown()
+
+
+def test_trainer_plus_aux_group_is_not_averaging_progress():
+    """A group of {me, aux} contributes nothing: with partner trainers
+    known to exist, applying the 'averaged' (= my own) gradients would
+    diverge the replicas — the round must be treated as failed/retryable
+    exactly like a singleton group."""
+    from dedloc_tpu.collaborative.progress import CollaborationState
+    from dedloc_tpu.core.timeutils import get_dht_time
+
+    dht = DHT(start=True, listen_host="127.0.0.1")
+    tx = lamb(0.05, weight_decay=0.0)
+    opt = CollaborativeOptimizer(tx, dht, "auxonly", **_opt_kwargs())
+    try:
+        params = {"w": jnp.array([[0.5], [0.5]])}
+        state = TrainState.create(params, tx)
+        acc_fn = make_accumulate_step(_toy_loss)
+        batch = _make_problem(0)
+        grad_acc = zeros_like_grads(params)
+        n_acc = jnp.zeros([], jnp.int32)
+        grad_acc, n_acc, _ = acc_fn(
+            state.params, grad_acc, n_acc, batch, jax.random.PRNGKey(0)
+        )
+
+        def fake_collab(force=False):
+            return CollaborationState(
+                optimizer_step=opt.local_step,
+                samples_accumulated=10**9,
+                target_batch_size=64,
+                num_peers=2,  # a partner trainer exists...
+                num_clients=0,
+                num_aux=1,
+                eta_next_step=0.0,
+                next_fetch_time=get_dht_time() + 60.0,
+            )
+
+        def aux_only_round(named, weight, round_id, **kw):
+            # ...but only the aux showed up: group of 2, 1 contributor
+            opt.averager.last_contributors = 1
+            return named, 2
+
+        opt.tracker.fetch_collaboration_state = fake_collab
+        opt.averager.step = aux_only_round
+        opt.averager.load_state_from_peers = lambda *a, **k: None
+
+        state, grad_acc, n_acc, stepped = opt.step(
+            state, grad_acc, n_acc, samples=16
+        )
+        assert not stepped, "an aux-only group must not count as averaging"
+        assert int(jax.device_get(n_acc)) == 1, "grads must be kept for retry"
+        assert opt.local_step == 0
+    finally:
+        opt.shutdown()
+        dht.shutdown()
+
+
+def test_member_aux_flag_roundtrip_and_legacy_unpack():
+    from dedloc_tpu.averaging.matchmaking import Member
+
+    m = Member(b"p", ("127.0.0.1", 1), 5.0, b"s", aux=True)
+    assert Member.unpack(m.pack()).aux is True
+    # legacy 4-field member records (pre-aux peers) default to contributor
+    assert Member.unpack([b"p", None, 1.0, b""]).aux is False
