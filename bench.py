@@ -325,16 +325,24 @@ def main() -> None:
     # change: default policy dots_no_batch -> dots_no_batch_attn and block
     # length 5 -> 10 iters (see BASELINE.md round-3 notes for both the old-
     # and new-methodology numbers so rounds stay comparable).
-    remat = os.environ.get("DEDLOC_BENCH_REMAT", "dots_no_batch_attn")
+    # Round-4 recipe: fused add+LN Pallas kernel + the fused_ln remat policy,
+    # micro-batch 12 (the B sweep's sweet spot — small enough that XLA stops
+    # inserting remat-compression copies, large enough to feed the MXU;
+    # 8/10/14/16/24/32 all measured slower, BASELINE.md round-4 notes).
+    remat = os.environ.get("DEDLOC_BENCH_REMAT", "fused_ln")
+    # the fused_ln policy only makes sense with the fused add+LN kernel on
+    fused_ln = remat == "fused_ln"
     per_step_env = int(os.environ.get("DEDLOC_BENCH_BATCH", "0"))
     if tiny:  # CI smoke on CPU
-        cfg = AlbertConfig.tiny(remat_policy=remat, attention_impl=impl)
+        cfg = AlbertConfig.tiny(remat_policy=remat, attention_impl=impl,
+                                fused_ln=fused_ln)
         accum, per_step, seq, iters = 2, 4, 64, 3
     else:
-        cfg = AlbertConfig.large(remat_policy=remat, attention_impl=impl)
+        cfg = AlbertConfig.large(remat_policy=remat, attention_impl=impl,
+                                 fused_ln=fused_ln)
         # iters per block: one scalar readback (~90 ms tunnel RTT) per block,
         # so longer blocks report closer to the true device rate
-        accum, per_step, seq, iters = 2, 32, 512, 10
+        accum, per_step, seq, iters = 2, 12, 512, 10
     if per_step_env:
         per_step = per_step_env
     # gathered masked-position MLM head: vocab projection only where labels

@@ -212,7 +212,7 @@ class NatTraversal:
             self._routes[peer_hex] = writer
         return {"registered": True}
 
-    async def _rpc_reverse_connect(self, _ep: Endpoint, args) -> dict:
+    async def _rpc_reverse_connect(self, arrived_on: Endpoint, args) -> dict:
         # dialing back parks OUR pooled connection at the public peer; its
         # calls then arrive on it and dispatch via reverse_handlers
         dial = (args["dial"][0], int(args["dial"][1]))
@@ -221,10 +221,10 @@ class NatTraversal:
             # an existing pooled connection to the solicitor may be the
             # dead half of the very path being re-solicited (symmetric
             # half-open death never EOFs) — but it may also be a healthy
-            # shared connection (e.g. our relay registration, when the
-            # solicitor IS our relay), so never evict blindly: try the
-            # register over it with a bounded budget, and only on silence
-            # evict and dial fresh
+            # shared connection (our relay registration, when the solicitor
+            # IS our relay), so never evict blindly: try the register over
+            # it with a bounded budget, and only on silence evict and dial
+            # fresh
             try:
                 await self.client.call(
                     dial, "nat.register", reg,
@@ -235,6 +235,14 @@ class NatTraversal:
                 )
                 return {"dialed": True}
             except (asyncio.TimeoutError, ConnectionError, OSError):
+                if dial == arrived_on:
+                    # THIS solicitation was just delivered over that very
+                    # connection, so the path is alive — merely slow under
+                    # load (e.g. queued behind a bulk relay transfer).
+                    # Evicting would kill every in-flight RPC on it and
+                    # unregister us from our own relay; surface the
+                    # timeout instead and let the solicitor retry.
+                    raise
                 self.client._drop(
                     dial, ConnectionResetError("re-dial solicited")
                 )

@@ -22,6 +22,7 @@ from typing import Any, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 Dtype = Any
 
@@ -47,8 +48,14 @@ class AlbertConfig:
     remat: bool = True
     # rematerialization policy for the scanned layer: "nothing" saves no
     # activations (min HBM), "dots" saves matmul outputs (fewer recomputed
-    # MXU ops when HBM allows)
+    # MXU ops when HBM allows), "fused_ln" pairs with fused_ln=True (saves
+    # exactly the named matmuls + every Pallas kernel's outputs, so the
+    # backward replays no elementwise chain at all)
     remat_policy: str = "nothing"
+    # fuse each residual-add + LayerNorm into one Pallas pass (fp32 stats,
+    # one-kernel backward); numerics match the unfused path to bf16
+    # precision. Off TPU the kernel runs in interpreter mode.
+    fused_ln: bool = False
     # "dense" (materialized S² scores), "blockwise" (online-softmax over KV
     # blocks via lax.scan, O(S·block) memory — the long-context path),
     # "flash" (the same math as ONE fused Pallas kernel with a custom-VJP
@@ -91,6 +98,37 @@ def _dense(features: int, cfg: AlbertConfig, name: str) -> nn.Dense:
         kernel_init=nn.initializers.normal(cfg.initializer_range),
         name=name,
     )
+
+
+class AddLayerNorm(nn.Module):
+    """``LayerNorm(x + residual)`` with the same parameter tree as
+    ``nn.LayerNorm`` (scale/bias), so checkpoints are interchangeable.
+
+    With ``cfg.fused_ln`` the add→stats→normalize chain runs as ONE Pallas
+    pass each way (ops/fused_ln.py) instead of several HBM passes in the
+    remat replay. Both paths now perform the residual ADD in fp32 (the
+    pre-round-4 code added in ``cfg.dtype`` before the fp32-stat LN, so
+    bf16 configs differ from older runs at bf16-rounding level — a strict
+    precision improvement, and fused/unfused match each other)."""
+
+    cfg: AlbertConfig
+
+    @nn.compact
+    def __call__(self, x, residual):
+        cfg = self.cfg
+        h = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (h,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (h,), jnp.float32)
+        from dedloc_tpu.ops.fused_ln import ln_residual, ln_residual_reference
+
+        if cfg.fused_ln:
+            return ln_residual(
+                x, residual, scale, bias, eps=cfg.layer_norm_eps
+            ).astype(cfg.dtype)
+        return ln_residual_reference(
+            x.astype(jnp.float32), residual.astype(jnp.float32),
+            scale, bias, eps=cfg.layer_norm_eps,
+        ).astype(cfg.dtype)
 
 
 class AlbertSelfAttention(nn.Module):
@@ -177,8 +215,7 @@ class AlbertSelfAttention(nn.Module):
         out = _dense(cfg.hidden_size, cfg, "dense")(ctx)
         if cfg.hidden_dropout_prob > 0.0 and not deterministic:
             out = nn.Dropout(cfg.hidden_dropout_prob)(out, deterministic=deterministic)
-        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
-                            name="layernorm")(hidden + out).astype(cfg.dtype)
+        return AddLayerNorm(cfg, name="layernorm")(out, hidden)
 
 
 class AlbertLayer(nn.Module):
@@ -194,13 +231,17 @@ class AlbertLayer(nn.Module):
         hidden = AlbertSelfAttention(cfg, deterministic, name="attention")(
             hidden, attn_bias
         )
-        ffn = _dense(cfg.intermediate_size, cfg, "ffn")(hidden)
+        # named for the fused_ln remat policy: the FFN up-projection is the
+        # one matmul output the backward cannot cheaply recompute (gelu's
+        # input); everything downstream is covered by saved Pallas outputs
+        ffn = checkpoint_name(
+            _dense(cfg.intermediate_size, cfg, "ffn")(hidden), "ffn_up"
+        )
         ffn = nn.gelu(ffn, approximate=True)
         ffn = _dense(cfg.hidden_size, cfg, "ffn_output")(ffn)
         if cfg.hidden_dropout_prob > 0.0 and not deterministic:
             ffn = nn.Dropout(cfg.hidden_dropout_prob)(ffn, deterministic=deterministic)
-        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
-                            name="layernorm")(hidden + ffn).astype(cfg.dtype)
+        return AddLayerNorm(cfg, name="layernorm")(ffn, hidden)
 
 
 def _pallas_outputs_saveable(prim, *_, **__) -> bool:
@@ -232,6 +273,21 @@ class _ScannedAlbertLayer(nn.Module):
                 "dots_no_batch_attn": (
                     jax.checkpoint_policies.save_from_both_policies(
                         jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                        _pallas_outputs_saveable,
+                    )
+                ),
+                # fused-LN recipe (pairs with cfg.fused_ln): save ONLY the
+                # named matmul outputs (q/k/v in flash layout, FFN up) plus
+                # every Pallas kernel's outputs — flash (out, lse) and the
+                # fused add+LN's (y, x̂, rstd). The backward then replays no
+                # elementwise chain; dropping the two out-projection dot
+                # saves pays for the x̂ residuals, so HBM is ~neutral vs
+                # dots_no_batch_attn.
+                "fused_ln": (
+                    jax.checkpoint_policies.save_from_both_policies(
+                        jax.checkpoint_policies.save_only_these_names(
+                            "flash_qkv", "ffn_up"
+                        ),
                         _pallas_outputs_saveable,
                     )
                 ),

@@ -447,13 +447,21 @@ def flash_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, s, h, d = q.shape
-    to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    # named in KERNEL layout so the fused_ln remat policy saves exactly what
+    # the flash backward consumes — the replay then skips the [B,S,H,D] ->
+    # [BH,S,D] relayout passes too
+    from jax.ad_checkpoint import checkpoint_name
+
+    to3 = lambda x, nm: checkpoint_name(
+        x.transpose(0, 2, 1, 3).reshape(b * h, s, d), nm
+    )
     if bias is None:
         bias3 = jnp.zeros((b * h, 1, s), jnp.float32)
     else:
         bias3 = jnp.broadcast_to(
             bias[:, None, :], (b, h, s)
         ).reshape(b * h, 1, s).astype(jnp.float32)
-    out3 = _flash(to3(q), to3(k), to3(v), bias3, block_q, block_k, interpret)
+    out3 = _flash(to3(q, "flash_qkv"), to3(k, "flash_qkv"),
+                  to3(v, "flash_qkv"), bias3, block_q, block_k, interpret)
     out3 = _unpack_heads(out3, b * h, d)  # paired layout -> [BH, S, D]
     return out3.reshape(b, h, s, d).transpose(0, 2, 1, 3)

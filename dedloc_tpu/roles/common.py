@@ -41,6 +41,9 @@ def build_model(
     overrides = {}
     if remat_policy:
         overrides["remat_policy"] = remat_policy
+        # the fused_ln policy's saved set only covers the backward when the
+        # fused add+LN kernel produces it — the two are one recipe
+        overrides["fused_ln"] = remat_policy == "fused_ln"
     if attention_impl:
         overrides["attention_impl"] = attention_impl
     if vocab_size:
