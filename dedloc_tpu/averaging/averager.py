@@ -72,9 +72,12 @@ class DecentralizedAverager:
         advertised_host: Optional[str] = None,
         authorizer=None,  # TokenAuthorizerBase for gated runs (joiner side)
         authority_public_key: Optional[bytes] = None,  # leader-side gate
-        relay: Optional[str] = None,  # "host:port" of a public peer whose
-        # RelayService makes this client-mode peer reachable (circuit relay,
-        # p2p/circuit-relay.md); listening peers all serve as relays
+        relay: Optional[str] = None,  # "host:port[,host2:port2,…]" public
+        # peers whose RelayService makes this client-mode peer reachable
+        # (circuit relay, p2p/circuit-relay.md); registration is
+        # k-redundant and the advertised endpoint fails over when the
+        # primary relay dies. Listening peers all serve as relays.
+        relay_keepalive_period: float = 5.0,
     ):
         if relay and not client_mode:
             # a listening peer IS a relay; accepting (and dropping) the flag
@@ -97,6 +100,7 @@ class DecentralizedAverager:
         self.averaging_expiration = averaging_expiration
         self.averaging_timeout = averaging_timeout
         self.target_group_size = target_group_size
+        self.relay_keepalive_period = relay_keepalive_period
         self._listen = (listen_host, listen_port)
         self._advertised_host = advertised_host or "127.0.0.1"
         self._shared_state: Optional[Tuple[Dict[str, np.ndarray], Dict[str, Any]]] = None
@@ -134,65 +138,119 @@ class DecentralizedAverager:
                 else:
                     self.peer_id = node.node_id.to_bytes()
                 if client_mode and relay:
-                    # circuit relay: park an outbound connection at the
-                    # public peer; our RPC methods (mm.join, allreduce,
-                    # state.get is withheld — no state sharing in client
-                    # mode) become reachable at the virtual endpoint, so
-                    # this peer can lead groups and host spans like a
-                    # listening peer, with bytes riding the relay
-                    host, _, port = relay.rpartition(":")
-                    relay_ep = (host, int(port))
+                    # circuit relay: park an outbound connection at EVERY
+                    # listed public peer (comma-separated "host:port,…" —
+                    # the reference's private peers bootstrap off several
+                    # public nodes, p2p/NAT-traversal.md:20-23, so one
+                    # relay dying must not strand the peer); our RPC
+                    # methods (mm.join, allreduce; state.get is withheld —
+                    # no state sharing in client mode) become reachable at
+                    # the PRIMARY relay's virtual endpoint, and the
+                    # keepalive fails the advertisement over to a live
+                    # backup when the primary dies.
+                    relay_eps = []
+                    for spec in str(relay).split(","):
+                        spec = spec.strip()
+                        if spec:
+                            host, _, port = spec.rpartition(":")
+                            relay_eps.append((host, int(port)))
                     registry = RPCServer()  # handler registry; never listens
                     self.server = registry
                     self.client.reverse_handlers = registry._handlers
-                    self.endpoint = await self.client.register_with_relay(
-                        relay_ep, self.peer_id
-                    )
+                    self._relay_endpoints = relay_eps
+                    self.endpoint = None
+                    for ep in relay_eps:
+                        try:
+                            vep = await self.client.register_with_relay(
+                                ep, self.peer_id
+                            )
+                            if self.endpoint is None:
+                                self.endpoint = vep  # primary = first live
+                        except Exception as e:  # noqa: BLE001
+                            logger.warning(
+                                f"relay {ep} registration failed: {e!r}"
+                            )
+                    if self.endpoint is None:
+                        raise ConnectionError(
+                            f"could not register with any relay of "
+                            f"{relay_eps}"
+                        )
 
                     async def keep_registered() -> None:
-                        # ACTIVE liveness probe: a dropped relay connection
-                        # silently unregisters us, and a half-open one (relay
-                        # power loss, NAT mapping expiry with no FIN) never
-                        # raises EOF — so ping the relay over the parked
-                        # connection every period. The ping shares the
-                        # ordered byte stream with multi-MB relayed tensor
-                        # frames, so a single slow pong is NOT evidence of
-                        # death: generous timeout, an RPC-level error reply
-                        # counts as alive (the connection answered), and the
-                        # connection is only dropped after two consecutive
-                        # silent failures.
-                        ping_failures = 0
+                        # ACTIVE liveness probe per relay: a dropped relay
+                        # connection silently unregisters us, and a
+                        # half-open one (relay power loss, NAT mapping
+                        # expiry with no FIN) never raises EOF — so ping
+                        # each relay over its parked connection every
+                        # period. The ping shares the ordered byte stream
+                        # with multi-MB relayed tensor frames, so a single
+                        # slow pong is NOT evidence of death: generous
+                        # timeout, an RPC-level error reply counts as alive
+                        # (the connection answered), and a connection is
+                        # only dropped after two consecutive silent
+                        # failures. When the PRIMARY relay is gone, the
+                        # advertised endpoint fails over to a live backup —
+                        # fresh matchmaking/state records then carry the
+                        # new virtual endpoint.
+                        from dedloc_tpu.dht.protocol import (
+                            parse_relay_endpoint,
+                            relay_endpoint,
+                        )
+
+                        period = self.relay_keepalive_period
+                        ping_failures = {ep: 0 for ep in relay_eps}
                         while True:
-                            await asyncio.sleep(5.0)
-                            if relay_ep in self.client._conns:
-                                try:
-                                    await self.client.call(
-                                        relay_ep, "relay.ping", {},
-                                        timeout=10.0,
-                                    )
-                                    ping_failures = 0
-                                    continue
-                                except RPCError:
-                                    ping_failures = 0  # answered => alive
-                                    continue
-                                except Exception:  # noqa: BLE001
-                                    ping_failures += 1
-                                    if ping_failures < 2:
-                                        continue
-                                    self.client._drop(
-                                        relay_ep,
-                                        ConnectionResetError(
-                                            "relay ping timed out twice"
-                                        ),
-                                    )
-                                    ping_failures = 0
-                            try:
-                                await self.client.register_with_relay(
-                                    relay_ep, self.peer_id
-                                )
-                                logger.info("re-registered with relay")
-                            except Exception as e:  # noqa: BLE001
-                                logger.debug(f"relay re-register: {e!r}")
+                            await asyncio.sleep(period)
+                            for ep in relay_eps:
+                                if ep in self.client._conns:
+                                    try:
+                                        await self.client.call(
+                                            ep, "relay.ping", {},
+                                            timeout=max(10.0, 2 * period),
+                                        )
+                                        ping_failures[ep] = 0
+                                    except RPCError:
+                                        ping_failures[ep] = 0  # answered
+                                    except Exception:  # noqa: BLE001
+                                        ping_failures[ep] += 1
+                                        if ping_failures[ep] >= 2:
+                                            self.client._drop(
+                                                ep,
+                                                ConnectionResetError(
+                                                    "relay ping timed out "
+                                                    "twice"
+                                                ),
+                                            )
+                                            ping_failures[ep] = 0
+                                if ep not in self.client._conns:
+                                    try:
+                                        await self.client.register_with_relay(
+                                            ep, self.peer_id
+                                        )
+                                        logger.info(
+                                            f"re-registered with relay {ep}"
+                                        )
+                                    except Exception as e:  # noqa: BLE001
+                                        logger.debug(
+                                            f"relay re-register {ep}: {e!r}"
+                                        )
+                            parsed = parse_relay_endpoint(self.endpoint)
+                            primary = parsed[0] if parsed else None
+                            if primary not in self.client._conns:
+                                for ep in relay_eps:
+                                    if ep in self.client._conns:
+                                        self.endpoint = relay_endpoint(
+                                            ep, self.peer_id
+                                        )
+                                        if hasattr(self, "matchmaking"):
+                                            self.matchmaking.endpoint = (
+                                                self.endpoint
+                                            )
+                                        logger.warning(
+                                            "relay failover: advertising "
+                                            f"via {ep}"
+                                        )
+                                        break
 
                     self._relay_keepalive = asyncio.ensure_future(
                         keep_registered()
@@ -209,7 +267,12 @@ class DecentralizedAverager:
                         advertised=self.endpoint,
                     )
                 elif client_mode and relay:
-                    conn = self.client._conns.get(relay_ep)
+                    conn = next(
+                        (self.client._conns[ep]
+                         for ep in self._relay_endpoints
+                         if ep in self.client._conns),
+                        None,
+                    )
                     bind_host = "127.0.0.1"
                     if conn is not None:
                         sockname = conn[1].get_extra_info("sockname")

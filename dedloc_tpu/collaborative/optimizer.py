@@ -98,6 +98,7 @@ class CollaborativeOptimizer:
         allow_state_sharing: bool = True,
         mesh=None,
         opt_state_sharding=None,  # ZeRO-1 moment layout (parallel.zero)
+        param_sharding=None,  # tensor-parallel layout (parallel.sharding)
         verbose: bool = False,
         listen_host: str = "0.0.0.0",
         advertised_host: Optional[str] = None,
@@ -153,8 +154,10 @@ class CollaborativeOptimizer:
         self.local_samples_accumulated = 0
         self.mesh = mesh
         self.opt_state_sharding = opt_state_sharding
+        self.param_sharding = param_sharding
         self._apply_fn = make_apply_step(
-            tx, mesh=mesh, opt_state_sharding=opt_state_sharding
+            tx, mesh=mesh, opt_state_sharding=opt_state_sharding,
+            param_sharding=param_sharding,
         )
         # post-update transform on the new state (e.g. SwAV prototype
         # re-normalization — NormalizePrototypesHook.on_update capability,
@@ -490,7 +493,7 @@ class CollaborativeOptimizer:
         self.local_step = int(metadata.get("local_step", metadata.get("step", 0)))
         new_state = state.replace(
             step=jax.numpy.asarray(int(metadata.get("step", 0)), jax.numpy.int32),
-            params=self._device_put(params),
+            params=self._device_put(params, self.param_sharding),
             opt_state=self._device_put(opt_state, self.opt_state_sharding),
         )
         logger.info(f"loaded state from peers at global step {self.local_step}")

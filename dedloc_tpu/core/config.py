@@ -145,8 +145,11 @@ class DHTArguments:
     # empty = loopback (single-host runs)
     advertised_host: str = ""
     client_mode: bool = False  # outbound-only peer (albert/arguments.py:63-65)
-    # "host:port" of any public peer: a client-mode peer registers with its
-    # circuit relay and becomes able to lead groups / host spans through it
+    # "host:port[,host2:port2,…]" of public peers: a client-mode peer
+    # registers with every listed circuit relay (k-redundant, like the
+    # reference's several bootstrap nodes) and becomes able to lead groups
+    # / host spans through them; if the advertised relay dies, the peer
+    # fails over to a live backup automatically
     relay: str = ""
 
 
@@ -212,6 +215,12 @@ class TrainingArguments:
     # axis; with attention_impl="ring" the attention KV shards rotate around
     # that axis (ring attention) so no device ever holds the full S×S scores
     mesh_seq_devices: int = 1
+    # tensor parallelism: factor of mesh_devices assigned to a "model" mesh
+    # axis — params/grads/moments shard by the Megatron-style ALBERT rules
+    # (parallel/sharding.py) and XLA inserts the ICI collectives. Composes
+    # with data/seq axes and zero_sharding (ZeRO then shards only the
+    # moments TP left replicated).
+    mesh_model_devices: int = 1
     # ZeRO-1: shard optimizer moments over the slice mesh's data axis
     # (state memory / n_devices; params+grads stay replicated for the
     # cross-slice averager). Requires mesh_devices > 1.

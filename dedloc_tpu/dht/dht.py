@@ -54,6 +54,7 @@ class DHT:
         advertised_host: Optional[str] = None,
         num_replicas: int = 5,
         daemon: bool = True,
+        maintenance_interval: float = 30.0,  # 0 disables self-maintenance
     ):
         self._initial_peers = [_parse_endpoint(p) for p in initial_peers]
         self._listen = (listen_host, listen_port)
@@ -61,6 +62,7 @@ class DHT:
         self._validators = list(record_validators)
         self._advertised_host = advertised_host
         self._num_replicas = num_replicas
+        self._maintenance_interval = maintenance_interval
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._node: Optional[DHTNode] = None
         self._thread = threading.Thread(
@@ -98,6 +100,7 @@ class DHT:
                     client_mode=self._client_mode,
                     advertised_host=self._advertised_host,
                     num_replicas=self._num_replicas,
+                    maintenance_interval=self._maintenance_interval,
                 )
             except BaseException as e:  # noqa: BLE001 — surfaced to caller
                 self._startup_error = e

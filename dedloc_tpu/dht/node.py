@@ -51,6 +51,12 @@ class DHTNode:
         record_validators: Sequence[RecordValidatorBase] = (),
         client_mode: bool = False,
         advertised_host: Optional[str] = None,
+        maintenance_interval: float = 30.0,  # 0 disables the background loop
+        stale_peer_timeout: float = 75.0,
+        bucket_refresh_interval: float = 120.0,
+        replication_interval: float = 600.0,  # Kademlia-style, much slower
+        # than eviction/refresh: a full lookup+store fan-out per held record
+        # every 30s would be orders of magnitude more traffic than needed
     ) -> "DHTNode":
         self = object.__new__(cls)
         self.node_id = node_id or DHTID.generate()
@@ -59,6 +65,10 @@ class DHTNode:
         self.parallel_rpc = parallel_rpc
         self.request_timeout = request_timeout
         self.client_mode = client_mode
+        self.stale_peer_timeout = stale_peer_timeout
+        self.bucket_refresh_interval = bucket_refresh_interval
+        self.replication_interval = replication_interval
+        self._last_replication = 0.0  # monotonic; 0 => replicate on first pass
         self.routing_table = RoutingTable(self.node_id, bucket_size)
         self.storage = DHTLocalStorage()
         self.cache = DHTLocalStorage(maxsize=2000)
@@ -67,6 +77,7 @@ class DHTNode:
         self.server: Optional[RPCServer] = None
         self.port: Optional[int] = None
         self.advertised_host = advertised_host or "127.0.0.1"
+        self._maintenance_task: Optional[asyncio.Task] = None
         if not client_mode:
             self.server = RPCServer(listen_host, listen_port)
             for method in ("dht.ping", "dht.find", "dht.store"):
@@ -75,6 +86,10 @@ class DHTNode:
             self.port = self.server.port
         if initial_peers:
             await self.bootstrap(initial_peers)
+        if maintenance_interval > 0:
+            self._maintenance_task = asyncio.ensure_future(
+                self._maintenance_loop(maintenance_interval)
+            )
         return self
 
     @property
@@ -165,6 +180,8 @@ class DHTNode:
     ) -> List[NodeInfo]:
         """Iterative Kademlia lookup over the `dht.find` RPC."""
         k = k or self.bucket_size
+        # a lookup IS refresh activity for the target's bucket
+        self.routing_table.mark_range_refreshed(target)
         candidates: Dict[int, NodeInfo] = {
             n.node_id: n for n in self.routing_table.nearest_neighbors(target, k)
         }
@@ -333,7 +350,113 @@ class DHTNode:
         )
         return ValueWithExpiration(stripped, entry.expiration_time)
 
+    # ----------------------------------------------------------- maintenance
+
+    async def _maintenance_loop(self, interval: float) -> None:
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self.run_maintenance()
+            except Exception as e:  # noqa: BLE001 — keep the loop alive
+                logger.debug(f"dht maintenance pass failed: {e!r}")
+
+    async def run_maintenance(self) -> Dict[str, int]:
+        """One self-maintenance pass — the Kademlia housekeeping a
+        multi-hour churning run depends on (the capability hivemind's DHT
+        provides under albert/run_trainer.py:236-243):
+
+        1. **stale-peer eviction** — ping routing-table entries not heard
+           from within ``stale_peer_timeout``; unresponsive nodes are
+           evicted (replacement-cache candidates promote), so lookups stop
+           spraying RPCs at long-dead peers.
+        2. **bucket refresh** — a random-target lookup in every bucket
+           whose range saw no activity for ``bucket_refresh_interval``,
+           (re)discovering live peers for sparse regions of the ID space.
+        3. **record re-replication** — every unexpired locally-held record
+           is re-offered to the CURRENT ``num_replicas`` nearest nodes;
+           as membership churns, replicas migrate onto newer nodes, so a
+           record outlives every node that originally stored it (receivers
+           keep the newest expiration — idempotent).
+
+        Returns counters (tests and soak harnesses call this directly with
+        a fake clock instead of waiting out ``maintenance_interval``).
+        """
+        import time as _time
+
+        stats = {"evicted": 0, "refreshed_buckets": 0, "republished": 0}
+        now = _time.monotonic()
+        # 1. stale-peer eviction, pings in parallel (a mass disconnect must
+        # not serialize N x request_timeout inside one pass); ping success
+        # re-registers with a fresh last_seen via _ping's add_or_update
+        stale = [
+            info
+            for bucket in list(self.routing_table.buckets)
+            for info in list(bucket.nodes.values())
+            if now - info.last_seen >= self.stale_peer_timeout
+        ]
+        if stale:
+            alive = await asyncio.gather(
+                *(self._ping(info.endpoint) for info in stale)
+            )
+            for info, ok in zip(stale, alive):
+                if not ok:
+                    self.routing_table.remove_node(info.node_id)
+                    stats["evicted"] += 1
+        # 2. bucket refresh
+        for bucket in list(self.routing_table.buckets):
+            if (_time.monotonic() - bucket.last_refreshed
+                    < self.bucket_refresh_interval):
+                continue
+            target = self.routing_table.random_id_in(bucket)
+            await self.find_nearest_nodes(target)
+            bucket.last_refreshed = _time.monotonic()
+            stats["refreshed_buckets"] += 1
+        # 3. record re-replication — on its own (much longer) cadence
+        due = (
+            _time.monotonic() - self._last_replication
+            >= self.replication_interval
+        )
+        if not self.client_mode and due:
+            self._last_replication = _time.monotonic()
+            dht_now = get_dht_time()
+            for key in self.storage.keys():
+                entry = self.storage.get(key)  # prunes expired subkeys
+                if entry is None or entry.expiration_time <= dht_now:
+                    continue
+                if isinstance(entry.value, DictionaryDHTValue):
+                    records = [
+                        [key, sk, v.value, v.expiration_time]
+                        for sk, v in entry.value.items()
+                        if v.expiration_time > dht_now
+                    ]
+                else:
+                    records = [[key, None, entry.value, entry.expiration_time]]
+                if not records:
+                    continue
+                key_id = DHTID.of_key(key)
+                nearest = await self.find_nearest_nodes(
+                    key_id, k=self.num_replicas
+                )
+                targets = [n for n in nearest if n.node_id != self.node_id]
+                if not targets:
+                    continue
+                await asyncio.gather(
+                    *(
+                        self.client.call(
+                            n.endpoint,
+                            "dht.store",
+                            {**self._sender_args(), "records": records},
+                        )
+                        for n in targets
+                    ),
+                    return_exceptions=True,
+                )
+                stats["republished"] += 1
+        return stats
+
     async def shutdown(self) -> None:
+        if self._maintenance_task is not None:
+            self._maintenance_task.cancel()
         await self.client.close()
         if self.server is not None:
             await self.server.stop()

@@ -57,6 +57,9 @@ class KBucket:
         self.lower, self.upper, self.k = lower, upper, k
         self.nodes: Dict[DHTID, NodeInfo] = {}  # insertion-ordered
         self.replacement_cache: Dict[DHTID, NodeInfo] = {}
+        # when this bucket's range last saw lookup/refresh activity — the
+        # Kademlia bucket-refresh trigger (DHTNode.run_maintenance)
+        self.last_refreshed: float = time.monotonic()
 
     def covers(self, node_id: int) -> bool:
         return self.lower <= node_id < self.upper
@@ -113,10 +116,21 @@ class RoutingTable:
         mid = (bucket.lower + bucket.upper) // 2
         left = KBucket(bucket.lower, mid, self.bucket_size)
         right = KBucket(mid, bucket.upper, self.bucket_size)
+        left.last_refreshed = right.last_refreshed = bucket.last_refreshed
         for info in bucket.nodes.values():
             (left if left.covers(info.node_id) else right).add_or_update(info)
         idx = self.buckets.index(bucket)
         self.buckets[idx : idx + 1] = [left, right]
+
+    def random_id_in(self, bucket: KBucket) -> DHTID:
+        """A uniform ID inside the bucket's range (bucket-refresh target)."""
+        import random
+
+        return DHTID(random.randrange(bucket.lower, bucket.upper))
+
+    def mark_range_refreshed(self, target: int) -> None:
+        """Record lookup activity for the bucket covering ``target``."""
+        self._bucket_for(target).last_refreshed = time.monotonic()
 
     def remove_node(self, node_id: DHTID) -> None:
         self._bucket_for(node_id).remove(node_id)

@@ -125,5 +125,8 @@ class DHTLocalStorage:
     def items(self):
         return self._data.items()
 
+    def keys(self):
+        return list(self._data.keys())
+
     def __len__(self):
         return len(self._data)

@@ -58,6 +58,7 @@ def make_accumulate_step(
     mesh: Optional[Mesh] = None,
     seq_axis: Optional[str] = None,
     seq_length: Optional[int] = None,
+    param_sharding: Optional[Any] = None,
 ) -> Callable:
     """Build jitted (params, grad_acc, n_acc, batch, rng) -> (grad_acc', n_acc', metrics).
 
@@ -97,14 +98,17 @@ def make_accumulate_step(
     kwargs = dict(donate_argnums=(1, 2))
     if mesh is not None:
         repl = NamedSharding(mesh, P())
+        # tensor parallelism: params (and the param-shaped grad accumulator)
+        # carry the Megatron-style layout; XLA inserts the ICI collectives
+        p_sh = param_sharding if param_sharding is not None else repl
         # seq-parallel: leave the batch sharding UNSPECIFIED so the per-leaf
         # layout committed by put_batch (seq dims over seq_axis) flows in
         # as-is; the in-step constraint above is then a no-op safety net
         # instead of an every-micro-batch reshard
         data = None if seq_axis is not None else NamedSharding(mesh, P("data"))
         kwargs.update(
-            in_shardings=(repl, repl, repl, data, repl),
-            out_shardings=(repl, repl, repl),
+            in_shardings=(p_sh, p_sh, repl, data, repl),
+            out_shardings=(p_sh, repl, repl),
         )
     return jax.jit(step, **kwargs)
 
@@ -113,12 +117,14 @@ def make_apply_step(
     tx: optax.GradientTransformation,
     mesh: Optional[Mesh] = None,
     opt_state_sharding: Optional[Any] = None,
+    param_sharding: Optional[Any] = None,
 ) -> Callable:
     """Build jitted (state, mean_grads) -> state'. Runs once per global step.
 
     ``opt_state_sharding`` (a NamedSharding pytree from
     ``parallel.zero.opt_state_shardings``) keeps optimizer moments sharded
-    ZeRO-style across updates: params/grads stay replicated, GSPMD inserts
+    ZeRO-style across updates; ``param_sharding`` keeps params (and the
+    incoming mean grads) in their tensor-parallel layout. GSPMD inserts
     whatever movement the elementwise update needs.
     """
 
@@ -132,12 +138,15 @@ def make_apply_step(
     kwargs = dict(donate_argnums=(0,))
     if mesh is not None:
         repl = NamedSharding(mesh, P())
-        if opt_state_sharding is not None:
+        p_sh = param_sharding if param_sharding is not None else repl
+        if opt_state_sharding is not None or param_sharding is not None:
             state_sh = TrainState(
-                step=repl, params=repl, opt_state=opt_state_sharding
+                step=repl, params=p_sh,
+                opt_state=opt_state_sharding
+                if opt_state_sharding is not None else repl,
             )
             kwargs.update(
-                in_shardings=(state_sh, repl), out_shardings=state_sh
+                in_shardings=(state_sh, p_sh), out_shardings=state_sh
             )
         else:
             kwargs.update(in_shardings=(repl, repl), out_shardings=repl)

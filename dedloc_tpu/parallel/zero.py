@@ -41,13 +41,30 @@ def _spec_for_leaf(leaf, mesh: Mesh, axis: str) -> P:
     return P()
 
 
-def opt_state_shardings(opt_state: Any, mesh: Mesh, axis: str = "data") -> Any:
-    """NamedSharding pytree for an optimizer state (ZeRO-1 layout) — feed
-    this to ``make_apply_step(opt_state_sharding=...)``."""
-    return jax.tree.map(
-        lambda l: NamedSharding(mesh, _spec_for_leaf(l, mesh, axis)),
-        opt_state,
-    )
+def opt_state_shardings(
+    opt_state: Any, mesh: Mesh, axis: Any = "data", tp_rules: Any = None
+) -> Any:
+    """NamedSharding pytree for an optimizer state — feed this to
+    ``make_apply_step(opt_state_sharding=...)``.
+
+    ``axis``: ZeRO-1 data-axis sharding (None disables). ``tp_rules``:
+    tensor-parallel path rules (parallel/sharding.py) — moment leaves whose
+    paths match (mu/nu mirror the param tree's paths) follow their param's
+    TP layout, and ZeRO applies only to what TP left replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+    out = []
+    for path, leaf in flat:
+        spec = P()
+        if tp_rules is not None and "model" in mesh.axis_names:
+            from dedloc_tpu.parallel.sharding import spec_for_path
+
+            spec = spec_for_path(jax.tree_util.keystr(path), tp_rules)
+        if spec == P() and axis is not None:
+            spec = _spec_for_leaf(leaf, mesh, axis)
+        out.append(NamedSharding(mesh, spec))
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def shard_opt_state(opt_state: Any, mesh: Mesh, axis: str = "data") -> Any:

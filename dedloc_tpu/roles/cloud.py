@@ -188,14 +188,15 @@ def aux_startup(spec: CloudFleetSpec, coordinator_host: str) -> str:
     return "\n".join(lines)
 
 
-class GcloudTPUProvider:
-    """gcloud-backed provider: TPU VMs for workers, GCE for the rest.
+class _CliProvider:
+    """Shared scaffolding for CLI-backed providers: command recording,
+    dry-run bookkeeping, and startup-script temp files.
 
     ``dry_run=True`` records the exact command lines instead of executing —
-    CI asserts them, operators copy-paste them."""
+    CI asserts them, operators copy-paste them (script temp files are KEPT
+    in dry-run so the recorded ``file://`` references stay usable)."""
 
-    def __init__(self, zone: str, dry_run: bool = False):
-        self.zone = zone
+    def __init__(self, dry_run: bool = False):
         self.dry_run = dry_run
         self.commands: List[str] = []
         self.startup_scripts: Dict[str, str] = {}
@@ -214,27 +215,44 @@ class GcloudTPUProvider:
             )
         return out.stdout
 
+    def _with_script_file(self, name: str, content: str, fn) -> None:
+        """Write ``content`` to a temp file, call ``fn(path)``, clean up —
+        except in dry-run, where the file must outlive the recorded
+        command for operators to replay it."""
+        import os as _os
+        import tempfile
+
+        script_file = tempfile.NamedTemporaryFile(
+            "w", prefix=f"startup-{name}-", suffix=".sh", delete=False
+        )
+        script_file.write(content)
+        script_file.close()
+        try:
+            fn(script_file.name)
+        finally:
+            if not self.dry_run:
+                _os.unlink(script_file.name)
+
+
+class GcloudTPUProvider(_CliProvider):
+    """gcloud-backed provider: TPU VMs for workers, GCE for the rest."""
+
+    def __init__(self, zone: str, dry_run: bool = False):
+        super().__init__(dry_run)
+        self.zone = zone
+
     def create(self, name: str, kind: str, machine: str,
                startup_script: str, spot: bool) -> None:
         # the script goes through --metadata-from-file: an inline
         # --metadata value would need quoting the guest shell must NOT see
         # (argv exec adds no shell layer to strip it) and commas inside the
         # script would split metadata entries
-        import tempfile
-
-        script_file = tempfile.NamedTemporaryFile(
-            "w", prefix=f"startup-{name}-", suffix=".sh", delete=False
-        )
-        script_file.write(startup_script)
-        script_file.close()
         self.startup_scripts[name] = startup_script
-        try:
-            self._create_with_script(name, kind, machine, script_file.name,
-                                     spot)
-        finally:
-            import os as _os
-
-            _os.unlink(script_file.name)
+        self._with_script_file(
+            name, startup_script,
+            lambda path: self._create_with_script(name, kind, machine, path,
+                                                  spot),
+        )
         if self.dry_run:
             self._dry_alive.append(name)
 
@@ -283,6 +301,101 @@ class GcloudTPUProvider:
             argv = ["gcloud", "compute", "instances", "delete", name,
                     f"--zone={self.zone}", "--quiet"]
         self._run(argv)
+
+
+class AwsEc2Provider(_CliProvider):
+    """aws-cli-backed provider — the reference's actual cloud
+    (albert/AWS_runner.ipynb: r5.large coordinator + g4dn spot workers +
+    CPU aux, provisioned via boto3; here via the ``aws ec2`` CLI so the
+    dry-run surface matches the gcloud driver's).
+
+    ``kind`` maps onto instance types, not services: EC2 has no TPU-VM
+    analogue, so "tpu" means "accelerated worker instance" (the notebook's
+    g4dn class). Spot uses the notebook's one-time,
+    terminate-on-interruption semantics — the respawn loop in
+    ``run_cloud_fleet`` is what brings capacity back, exactly like the
+    notebook's last cell. Instances are discovered by a fleet Name tag."""
+
+    def __init__(self, region: str, ami: str = "AMI_ID",
+                 key_name: str = "", security_group: str = "",
+                 dry_run: bool = False):
+        super().__init__(dry_run)
+        self.region = region
+        self.ami = ami
+        self.key_name = key_name
+        self.security_group = security_group
+
+    def create(self, name: str, kind: str, machine: str,
+               startup_script: str, spot: bool) -> None:
+        self.startup_scripts[name] = startup_script
+        # user-data rides in a file as the RAW script: file:// contents are
+        # base64-encoded by the aws CLI itself, so pre-encoding would hand
+        # cloud-init base64 text instead of an executable script
+        self._with_script_file(
+            name, startup_script, lambda path: self._run_create(
+                name, machine, path, spot
+            )
+        )
+        if self.dry_run:
+            self._dry_alive.append(name)
+
+    def _run_create(self, name: str, machine: str, script_path: str,
+                    spot: bool) -> None:
+        argv = [
+            "aws", "ec2", "run-instances",
+            f"--region={self.region}",
+            f"--image-id={self.ami}",
+            f"--instance-type={machine}",
+            "--count=1",
+            f"--user-data=file://{script_path}",
+            "--tag-specifications",
+            "ResourceType=instance,Tags=[{Key=Name,Value=%s}]" % name,
+        ]
+        if self.key_name:
+            argv.append(f"--key-name={self.key_name}")
+        if self.security_group:
+            argv.append(f"--security-group-ids={self.security_group}")
+        if spot:
+            # the notebook's one-time spot with terminate-on-interruption:
+            # a preempted worker is GONE and the supervisor respawns it
+            argv += [
+                "--instance-market-options",
+                "MarketType=spot,SpotOptions={SpotInstanceType=one-time,"
+                "InstanceInterruptionBehavior=terminate}",
+            ]
+        self._run(argv)
+
+    def list_alive(self) -> List[str]:
+        if self.dry_run:
+            self.commands.append("aws ec2 describe-instances ...")
+            return list(self._dry_alive)
+        out = self._run([
+            "aws", "ec2", "describe-instances",
+            f"--region={self.region}",
+            "--filters", "Name=instance-state-name,Values=pending,running",
+            "--query",
+            "Reservations[].Instances[].Tags[?Key=='Name'].Value[]",
+            "--output", "text",
+        ])
+        return [n for n in out.split() if n]
+
+    def delete(self, name: str, kind: str = "tpu") -> None:
+        if not self.dry_run:
+            ids = self._run([
+                "aws", "ec2", "describe-instances",
+                f"--region={self.region}",
+                "--filters", f"Name=tag:Name,Values={name}",
+                "Name=instance-state-name,Values=pending,running",
+                "--query", "Reservations[].Instances[].InstanceId",
+                "--output", "text",
+            ]).split()
+        else:
+            ids = [f"i-{name}"]
+        if ids:
+            self._run([
+                "aws", "ec2", "terminate-instances",
+                f"--region={self.region}", "--instance-ids", *ids,
+            ])
 
 
 def run_cloud_fleet(

@@ -62,20 +62,29 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         from dedloc_tpu.parallel.mesh import make_mesh, put_batch
 
         sp = max(1, args.training.mesh_seq_devices)
-        if args.training.mesh_devices % sp:
+        tp = max(1, args.training.mesh_model_devices)
+        if args.training.mesh_devices % (sp * tp):
             raise ValueError(
-                f"mesh_seq_devices ({sp}) must divide mesh_devices "
-                f"({args.training.mesh_devices})"
+                f"mesh_seq_devices ({sp}) x mesh_model_devices ({tp}) must "
+                f"divide mesh_devices ({args.training.mesh_devices})"
             )
+        dp = args.training.mesh_devices // (sp * tp)
+        names, dims = ["data"], [dp]
+        if tp > 1:
+            names.append("model"); dims.append(tp)
+        if sp > 1:
+            names.append("seq"); dims.append(sp)
         mesh = make_mesh(
             args.training.mesh_devices,
-            axis_names=("data", "seq") if sp > 1 else ("data",),
-            shape=(args.training.mesh_devices // sp, sp) if sp > 1 else None,
+            axis_names=tuple(names),
+            shape=tuple(dims) if len(dims) > 1 else None,
             device_offset=args.training.mesh_device_offset,
         )
         logger.info(f"slice mesh: {mesh.shape}")
-    elif args.training.mesh_seq_devices > 1:
-        raise ValueError("mesh_seq_devices > 1 requires mesh_devices > 1")
+    elif args.training.mesh_seq_devices > 1 or args.training.mesh_model_devices > 1:
+        raise ValueError(
+            "mesh_seq_devices/mesh_model_devices > 1 require mesh_devices > 1"
+        )
     if args.training.attention_impl == "ring" and (
         mesh is None or "seq" not in mesh.axis_names
     ):
@@ -126,13 +135,31 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
             "--training.zero_sharding shards optimizer moments over a slice "
             "mesh; set --training.mesh_devices > 1"
         )
+    # tensor parallelism: Megatron-style param layout over the "model" axis
+    # (parallel/sharding.py rules); moments follow their params' layout
+    param_sharding = None
+    if mesh is not None and "model" in mesh.axis_names:
+        from jax.sharding import NamedSharding
+        from dedloc_tpu.parallel.sharding import partition_specs
+
+        param_sharding = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), partition_specs(state.params)
+        )
     opt_sharding = None
-    if mesh is not None and args.training.zero_sharding:
+    if mesh is not None and (args.training.zero_sharding
+                             or param_sharding is not None):
         # ZeRO-1: LAMB moments shard over the slice's data axis; GSPMD
-        # inserts the gathers the elementwise update needs (parallel/zero.py)
+        # inserts the gathers the elementwise update needs (parallel/zero.py).
+        # With TP, moments of TP-sharded params follow the TP layout and
+        # ZeRO (when enabled) shards only the rest.
+        from dedloc_tpu.parallel.sharding import ALBERT_TP_RULES
         from dedloc_tpu.parallel.zero import opt_state_shardings
 
-        opt_sharding = opt_state_shardings(state.opt_state, mesh)
+        opt_sharding = opt_state_shardings(
+            state.opt_state, mesh,
+            axis="data" if args.training.zero_sharding else None,
+            tp_rules=ALBERT_TP_RULES if param_sharding is not None else None,
+        )
 
     opt = CollaborativeOptimizer(
         tx,
@@ -162,6 +189,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         allow_state_sharing=args.optimizer.allow_state_sharing,
         mesh=mesh,
         opt_state_sharding=opt_sharding,
+        param_sharding=param_sharding,
         authorizer=authorizer,
         authority_public_key=authority_public_key,
         verbose=True,
@@ -177,7 +205,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         repl = NamedSharding(mesh, P())
         state = state.replace(
             step=jax.device_put(state.step, repl),
-            params=jax.device_put(state.params, repl),
+            params=jax.device_put(state.params, param_sharding or repl),
             opt_state=jax.device_put(
                 state.opt_state, opt_sharding or repl
             ),
@@ -193,6 +221,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         seq_axis="seq" if (mesh is not None and "seq" in mesh.axis_names)
         else None,
         seq_length=seq,
+        param_sharding=param_sharding,
     )
     grad_acc = zeros_like_grads(state.params)
     n_acc = jnp.zeros([], jnp.int32)

@@ -1590,3 +1590,95 @@ def test_reversal_symmetric_halfopen_reestablishes_direct_route():
         await relay_server.stop()
 
     asyncio.run(run())
+
+
+def test_relay_failover_client_keeps_averaging(rng):
+    """VERDICT r3 #6: a client-mode peer registers with SEVERAL relays;
+    when the relay it advertises through dies mid-run, it fails over to a
+    live backup and keeps completing averaging rounds."""
+    from dedloc_tpu.averaging import DecentralizedAverager
+    from dedloc_tpu.dht import DHT
+    from dedloc_tpu.dht.protocol import (
+        RelayService,
+        RPCServer,
+        parse_relay_endpoint,
+    )
+
+    # standalone relay host R1 (no averager) + public averager A (whose
+    # server doubles as relay R2)
+    import asyncio as aio
+
+    loop_holder = {}
+
+    def run_relay_host():
+        async def serve():
+            server = RPCServer("127.0.0.1", 0)
+            await server.start()
+            RelayService(server)
+            loop_holder["server"] = server
+            loop_holder["port"] = server.port
+            loop_holder["stop"] = aio.Event()
+            loop_holder["ready"].set()
+            await loop_holder["stop"].wait()
+            await server.stop()
+
+        loop = aio.new_event_loop()
+        loop_holder["loop"] = loop
+        loop.run_until_complete(serve())
+
+    loop_holder["ready"] = threading.Event()
+    relay_thread = threading.Thread(target=run_relay_host, daemon=True)
+    relay_thread.start()
+    assert loop_holder["ready"].wait(10)
+    r1_port = loop_holder["port"]
+
+    root = DHT(start=True, listen_host="127.0.0.1")
+    d1 = DHT(start=True, listen_host="127.0.0.1",
+             initial_peers=[root.get_visible_address()], client_mode=True)
+    public = DecentralizedAverager(
+        root, "failover", averaging_expiration=2.0, averaging_timeout=20.0,
+        listen_host="127.0.0.1",
+    )
+    client = DecentralizedAverager(
+        d1, "failover", client_mode=True,
+        relay=f"127.0.0.1:{r1_port},127.0.0.1:{public.server.port}",
+        averaging_expiration=2.0, averaging_timeout=20.0,
+        compression="none", relay_keepalive_period=0.4,
+    )
+    try:
+        assert parse_relay_endpoint(client.endpoint)[0] == (
+            "127.0.0.1", r1_port
+        ), "primary advertisement must use the first live relay"
+
+        def round_ok(rid):
+            out = {}
+            t1 = threading.Thread(target=lambda: out.update(
+                pub=public.step({"v": np.ones(4, np.float32)}, 1.0, rid)))
+            t2 = threading.Thread(target=lambda: out.update(
+                cli=client.step({"v": 3 * np.ones(4, np.float32)}, 1.0, rid)))
+            t1.start(); t2.start(); t1.join(45); t2.join(45)
+            return (out.get("pub") and out["pub"][1] == 2
+                    and out.get("cli") and out["cli"][1] == 2
+                    and np.allclose(out["pub"][0]["v"], 2.0))
+
+        assert round_ok("r1"), "round via the primary relay failed"
+
+        # kill the primary relay host
+        loop_holder["loop"].call_soon_threadsafe(loop_holder["stop"].set)
+        relay_thread.join(10)
+
+        # wait for the keepalive to fail over the advertisement
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            parsed = parse_relay_endpoint(client.endpoint)
+            if parsed and parsed[0] == ("127.0.0.1", public.server.port):
+                break
+            time.sleep(0.2)
+        assert parse_relay_endpoint(client.endpoint)[0] == (
+            "127.0.0.1", public.server.port
+        ), "advertisement must fail over to the live backup relay"
+
+        assert round_ok("r2"), "round after relay death failed"
+    finally:
+        client.shutdown(); public.shutdown()
+        d1.shutdown(); root.shutdown()

@@ -518,3 +518,43 @@ def test_join_command_verbatim_gated(tmp_path):
         assert "not authorized" in (bad.stderr + bad.stdout)
     finally:
         root_dht.shutdown()
+
+
+def test_trainer_tensor_parallel_on_mesh(tmp_path):
+    """VERDICT r3 #7: tensor parallelism reachable from the trainer CLI —
+    a dp2 x tp2 slice peer shards params by the Megatron-style rules, still
+    makes global steps, and composes with ZeRO for the rest of the moments."""
+    from jax.sharding import PartitionSpec as P
+
+    args = _args(
+        tmp_path,
+        [
+            "--optimizer.target_batch_size", "16",
+            "--training.max_local_steps", "5",
+            "--training.save_steps", "0",
+            "--training.mesh_devices", "4",
+            "--training.mesh_model_devices", "2",
+            "--training.zero_sharding", "true",
+        ],
+    )
+    state = run_trainer(args)
+    assert int(state.step) >= 1
+    import jax
+
+    param_specs = [
+        str(getattr(leaf.sharding, "spec", P()))
+        for leaf in jax.tree.leaves(state.params)
+        if hasattr(leaf, "sharding")
+    ]
+    assert any("model" in s for s in param_specs), (
+        f"no param leaf sharded over the model axis: {param_specs}"
+    )
+    opt_specs = [
+        str(getattr(leaf.sharding, "spec", P()))
+        for leaf in jax.tree.leaves(state.opt_state)
+        if hasattr(leaf, "sharding")
+    ]
+    assert any("model" in s for s in opt_specs), "TP moments must follow params"
+    assert any("data" in s for s in opt_specs), (
+        "ZeRO must shard what TP left replicated"
+    )
