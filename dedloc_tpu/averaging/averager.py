@@ -158,12 +158,18 @@ class DecentralizedAverager:
                     self.server = registry
                     self.client.reverse_handlers = registry._handlers
                     self._relay_endpoints = relay_eps
+                    # relays we COMPLETED a registration with — failover must
+                    # never advertise a relay that merely has a TCP
+                    # connection (e.g. a non-relay RPC server would answer
+                    # pings yet route nothing)
+                    self._registered_relays: set = set()
                     self.endpoint = None
                     for ep in relay_eps:
                         try:
                             vep = await self.client.register_with_relay(
                                 ep, self.peer_id
                             )
+                            self._registered_relays.add(ep)
                             if self.endpoint is None:
                                 self.endpoint = vep  # primary = first live
                         except Exception as e:  # noqa: BLE001
@@ -199,58 +205,68 @@ class DecentralizedAverager:
 
                         period = self.relay_keepalive_period
                         ping_failures = {ep: 0 for ep in relay_eps}
+
+                        async def check_relay(ep) -> None:
+                            if ep in self.client._conns:
+                                try:
+                                    await self.client.call(
+                                        ep, "relay.ping", {},
+                                        timeout=max(10.0, 2 * period),
+                                    )
+                                    ping_failures[ep] = 0
+                                except RPCError:
+                                    ping_failures[ep] = 0  # answered
+                                except Exception:  # noqa: BLE001
+                                    ping_failures[ep] += 1
+                                    if ping_failures[ep] >= 2:
+                                        self.client._drop(
+                                            ep,
+                                            ConnectionResetError(
+                                                "relay ping timed out twice"
+                                            ),
+                                        )
+                                        self._registered_relays.discard(ep)
+                                        ping_failures[ep] = 0
+                            if (ep not in self.client._conns
+                                    or ep not in self._registered_relays):
+                                try:
+                                    await self.client.register_with_relay(
+                                        ep, self.peer_id
+                                    )
+                                    self._registered_relays.add(ep)
+                                    logger.info(
+                                        f"re-registered with relay {ep}"
+                                    )
+                                except Exception as e:  # noqa: BLE001
+                                    self._registered_relays.discard(ep)
+                                    logger.debug(
+                                        f"relay re-register {ep}: {e!r}"
+                                    )
+
                         while True:
                             await asyncio.sleep(period)
-                            for ep in relay_eps:
-                                if ep in self.client._conns:
-                                    try:
-                                        await self.client.call(
-                                            ep, "relay.ping", {},
-                                            timeout=max(10.0, 2 * period),
-                                        )
-                                        ping_failures[ep] = 0
-                                    except RPCError:
-                                        ping_failures[ep] = 0  # answered
-                                    except Exception:  # noqa: BLE001
-                                        ping_failures[ep] += 1
-                                        if ping_failures[ep] >= 2:
-                                            self.client._drop(
-                                                ep,
-                                                ConnectionResetError(
-                                                    "relay ping timed out "
-                                                    "twice"
-                                                ),
-                                            )
-                                            ping_failures[ep] = 0
-                                if ep not in self.client._conns:
-                                    try:
-                                        await self.client.register_with_relay(
-                                            ep, self.peer_id
-                                        )
-                                        logger.info(
-                                            f"re-registered with relay {ep}"
-                                        )
-                                    except Exception as e:  # noqa: BLE001
-                                        logger.debug(
-                                            f"relay re-register {ep}: {e!r}"
-                                        )
+                            # in parallel: one half-open relay must not
+                            # stall liveness detection for the others
+                            await asyncio.gather(
+                                *(check_relay(ep) for ep in relay_eps)
+                            )
                             parsed = parse_relay_endpoint(self.endpoint)
                             primary = parsed[0] if parsed else None
-                            if primary not in self.client._conns:
-                                for ep in relay_eps:
-                                    if ep in self.client._conns:
-                                        self.endpoint = relay_endpoint(
-                                            ep, self.peer_id
-                                        )
-                                        if hasattr(self, "matchmaking"):
-                                            self.matchmaking.endpoint = (
-                                                self.endpoint
-                                            )
-                                        logger.warning(
-                                            "relay failover: advertising "
-                                            f"via {ep}"
-                                        )
-                                        break
+                            healthy = [
+                                ep for ep in relay_eps
+                                if ep in self.client._conns
+                                and ep in self._registered_relays
+                            ]
+                            if primary not in healthy and healthy:
+                                ep = healthy[0]
+                                self.endpoint = relay_endpoint(
+                                    ep, self.peer_id
+                                )
+                                if hasattr(self, "matchmaking"):
+                                    self.matchmaking.endpoint = self.endpoint
+                                logger.warning(
+                                    f"relay failover: advertising via {ep}"
+                                )
 
                     self._relay_keepalive = asyncio.ensure_future(
                         keep_registered()

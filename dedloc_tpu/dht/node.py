@@ -68,7 +68,10 @@ class DHTNode:
         self.stale_peer_timeout = stale_peer_timeout
         self.bucket_refresh_interval = bucket_refresh_interval
         self.replication_interval = replication_interval
-        self._last_replication = 0.0  # monotonic; 0 => replicate on first pass
+        # None => replicate on the first pass (a 0.0 monotonic sentinel
+        # would silently delay it on recently-booted hosts, where
+        # monotonic() < replication_interval)
+        self._last_replication: Optional[float] = None
         self.routing_table = RoutingTable(self.node_id, bucket_size)
         self.storage = DHTLocalStorage()
         self.cache = DHTLocalStorage(maxsize=2000)
@@ -413,7 +416,8 @@ class DHTNode:
             stats["refreshed_buckets"] += 1
         # 3. record re-replication — on its own (much longer) cadence
         due = (
-            _time.monotonic() - self._last_replication
+            self._last_replication is None
+            or _time.monotonic() - self._last_replication
             >= self.replication_interval
         )
         if not self.client_mode and due:
