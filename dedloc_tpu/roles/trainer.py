@@ -236,6 +236,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
     loss_sum_dev = jnp.zeros([])
     mini_steps = 0
     boundary = 0
+    last_saved_step = 0
     # telemetry: phase timers on the flagship path (vissl PerfStats
     # capability, vissl/utils/perf_stats.py:12-249). data_wait and the
     # boundary wall are host-honest; per-micro-batch device time is NOT
@@ -355,9 +356,15 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
                 mini_steps = 0
                 if (
                     args.training.save_steps
-                    and opt.local_step % args.training.save_steps == 0
+                    and opt.local_step - last_saved_step
+                    >= args.training.save_steps
                 ):
+                    # cadence by DISTANCE, not divisibility: a collaborative
+                    # local_step can jump over exact multiples (catch-ups
+                    # adopt the global counter), and a modulo check then
+                    # never fires again for the rest of the run
                     _save(args, state, opt.local_step)
+                    last_saved_step = opt.local_step
 
             boundary += 1
             if (
