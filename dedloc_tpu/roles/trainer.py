@@ -119,8 +119,9 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
 
     # local resume (run_trainer.py:56-70): newest checkpoint* dir wins
     resumed = load_latest_checkpoint(args.training.output_dir)
+    resumed_local_step = 0
     if resumed is not None:
-        step, tree, _meta = resumed
+        step, tree, meta = resumed
         template = jax.device_get((state.params, state.opt_state))
         params_t, opt_t = _named_to_tree_pair(tree, template)
         state = state.replace(
@@ -128,6 +129,11 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
             params=jax.device_put(params_t),
             opt_state=jax.device_put(opt_t),
         )
+        # carry the COLLABORATIVE counter too: when a whole collaboration
+        # restarts from disk (fresh DHT, nobody to pull state from), round
+        # ids and published metrics must continue from the checkpoint's
+        # global step, not restart at 0
+        resumed_local_step = int(meta.get("local_step", step))
         logger.info(f"resumed from local checkpoint at step {step}")
 
     if args.training.zero_sharding and mesh is None:
@@ -195,6 +201,10 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         verbose=True,
     )
     # catch up with the collaboration before training (:124-128)
+    # disk-resume seeds the collaborative counter; a LIVE collaboration
+    # (state providers) below still wins — load_state_from_peers overwrites
+    # local_step when a newer peer state exists
+    opt.local_step = max(opt.local_step, resumed_local_step)
     state = opt.load_state_from_peers(state)
     if mesh is not None:
         # commit state onto the mesh once — otherwise accumulate's
@@ -236,7 +246,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
     loss_sum_dev = jnp.zeros([])
     mini_steps = 0
     boundary = 0
-    last_saved_step = 0
+    last_saved_step = opt.local_step
     # telemetry: phase timers on the flagship path (vissl PerfStats
     # capability, vissl/utils/perf_stats.py:12-249). data_wait and the
     # boundary wall are host-honest; per-micro-batch device time is NOT

@@ -70,9 +70,32 @@ def test_trainer_resumes_from_checkpoint(tmp_path):
     state = run_trainer(args)
     first_run_step = int(state.step)
     assert first_run_step >= 1
-    # second run resumes from disk: global step monotonically continues
-    state2 = run_trainer(args)
+    # second run resumes from disk: global step monotonically continues —
+    # including the COLLABORATIVE counter (fresh DHT, nobody to pull state
+    # from: round ids/metrics must continue from the checkpoint, not step 0)
+    import logging
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    capture = _Capture()
+    logging.getLogger("dedloc_tpu").addHandler(capture)
+    try:
+        state2 = run_trainer(args)
+    finally:
+        logging.getLogger("dedloc_tpu").removeHandler(capture)
     assert int(state2.step) >= first_run_step
+    steps_logged = [
+        int(m.split("global step ")[1].split(":")[0])
+        for m in records if m.startswith("global step ") and ":" in m
+    ]
+    assert steps_logged and min(steps_logged) > first_run_step, (
+        f"collaborative counter restarted: {steps_logged[:3]} after "
+        f"first run ended at {first_run_step}"
+    )
 
 
 def test_coordinator_aggregates_published_metrics(tmp_path):
