@@ -23,6 +23,46 @@ from dedloc_tpu.optim.schedules import linear_warmup_linear_decay
 logger = logging.getLogger(__name__)
 
 
+def load_split_examples(dataset_name: str, config_name: str):
+    """train/validation examples through the same ``datasets.load_dataset``
+    entry point the reference fine-tunes use (train_ner.py / train_ncc.py).
+    ``dataset_name`` may be a hub id (networked) or a local directory holding
+    ``train.jsonl`` / ``validation.jsonl`` with the dataset's columns, which
+    runs the identical Arrow ingestion path offline. Split files are selected
+    explicitly (``data_files``) so unrelated files living in the same dir —
+    a tokenizer.json, checkpoints — don't get swept into the dataset by
+    module inference."""
+    import glob
+    import os
+
+    from datasets import load_dataset  # deferred: heavy + networked
+
+    if os.path.isdir(dataset_name):
+        def split_files(*stems):
+            # exact stems only — train*.json* would sweep a train_log.jsonl
+            # run log into the training split
+            return sorted(
+                p
+                for stem in stems
+                for p in glob.glob(os.path.join(dataset_name, f"{stem}.json*"))
+            )
+
+        data_files = {
+            "train": split_files("train"),
+            "validation": split_files("validation", "val"),
+        }
+        missing = [k for k, v in data_files.items() if not v]
+        if missing:
+            raise FileNotFoundError(
+                f"{dataset_name} has no {'/'.join(missing)} data files "
+                "(expected train*.json[l] and valid*.json[l])"
+            )
+        ds = load_dataset("json", data_files=data_files)
+    else:
+        ds = load_dataset(dataset_name, config_name)
+    return list(ds["train"]), list(ds["validation"])
+
+
 @dataclasses.dataclass
 class FinetuneArguments:
     """Knobs mirroring the fine-tune TrainingArguments the reference sets."""
@@ -179,7 +219,18 @@ def finetune(
         deterministic=True,
     )["params"]
     if init_params is not None and "albert" in init_params:
-        # warm-start the backbone from the pretrained checkpoint
+        # warm-start the backbone from the pretrained checkpoint; leaf shapes
+        # must match the model config exactly — a silently-mismatched
+        # position table would clamp under jit instead of erroring
+        fresh = jax.tree_util.tree_map(jnp.shape, params["albert"])
+        loaded = jax.tree_util.tree_map(jnp.shape, init_params["albert"])
+        if fresh != loaded:
+            raise ValueError(
+                "checkpoint backbone does not match the model config "
+                "(e.g. --max_seq_length beyond the pretrained position table, "
+                "or a different --model_size than the checkpoint was trained "
+                f"with): expected {fresh}, got {loaded}"
+            )
         params = dict(params)
         params["albert"] = init_params["albert"]
     opt_state = tx.init(params)

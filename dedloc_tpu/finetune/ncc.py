@@ -27,8 +27,9 @@ SNA_BN_LABELS = ["kolkata", "state", "national", "international", "sports", "ent
 class NccArguments:
     model_checkpoint: str = ""  # checkpoint dir; "" = fresh backbone init
     tokenizer_path: str = ""  # tokenizer.json; "" = use model_checkpoint dir
-    dataset_name: str = "indic_glue"
+    dataset_name: str = "indic_glue"  # hub id or local data-files dir
     dataset_config_name: str = "sna.bn"
+    model_size: str = "large"  # AlbertConfig.named: tiny | large
     max_seq_length: int = 128
     train: FinetuneArguments = dataclasses.field(default_factory=FinetuneArguments)
 
@@ -105,18 +106,24 @@ def run_ncc(
 
 def main(argv=None) -> None:
     args = parse_config(NccArguments, argv)
-    from datasets import load_dataset
+    from dedloc_tpu.finetune.driver import load_split_examples
 
-    ds = load_dataset(args.dataset_name, args.dataset_config_name)
-    from dedloc_tpu.finetune.ner import load_backbone_params, resolve_tokenizer
+    train_examples, eval_examples = load_split_examples(
+        args.dataset_name, args.dataset_config_name
+    )
+    from dedloc_tpu.finetune.ner import (
+        load_backbone_params,
+        resolve_model_config,
+        resolve_tokenizer,
+    )
 
     tok = resolve_tokenizer(args.tokenizer_path, args.model_checkpoint)
     init_params = load_backbone_params(args.model_checkpoint)
     _, history = run_ncc(
         args,
-        AlbertConfig.large(),
-        list(ds["train"]),
-        list(ds["validation"]),
+        resolve_model_config(args.model_size, tok.vocab_size, args.max_seq_length),
+        train_examples,
+        eval_examples,
         tok.encode_ids,
         init_params=init_params,
         sep_token_id=tok.sep_id,

@@ -3,8 +3,9 @@
 Capability parity with sahajbert/train_ner.py: wikiann/bn word-level NER,
 label alignment onto sub-tokens (special tokens and continuations -> -100),
 pad-to-max static shapes, per-epoch eval with seqeval-style span P/R/F1 and
-early stopping on eval loss. The dataset fetch is a seam
-(``load_wikiann_bn``) so offline tests can inject word/tag lists directly.
+early stopping on eval loss. The dataset fetch (``driver.load_split_examples``)
+takes a hub id or a local data-files dir; offline tests can also inject
+word/tag lists directly via ``run_ner``.
 """
 from __future__ import annotations
 
@@ -15,7 +16,12 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from dedloc_tpu.core.config import parse_config
-from dedloc_tpu.finetune.driver import FinetuneArguments, evaluate, finetune
+from dedloc_tpu.finetune.driver import (
+    FinetuneArguments,
+    evaluate,
+    finetune,
+    load_split_examples,
+)
 from dedloc_tpu.finetune.metrics import align_labels_with_words, span_f1
 from dedloc_tpu.models.albert import AlbertConfig, AlbertForTokenClassification
 
@@ -30,8 +36,9 @@ WIKIANN_LABELS = ["O", "B-PER", "I-PER", "B-ORG", "I-ORG", "B-LOC", "I-LOC"]
 class NerArguments:
     model_checkpoint: str = ""  # checkpoint dir; "" = fresh backbone init
     tokenizer_path: str = ""  # tokenizer.json; "" = use model_checkpoint dir
-    dataset_name: str = "wikiann"
+    dataset_name: str = "wikiann"  # hub id or local data-files dir
     dataset_config_name: str = "bn"
+    model_size: str = "large"  # AlbertConfig.named: tiny | large
     max_seq_length: int = 128
     label_all_tokens: bool = False
     train: FinetuneArguments = dataclasses.field(default_factory=FinetuneArguments)
@@ -121,14 +128,6 @@ def run_ner(
     )
 
 
-def load_wikiann_bn(dataset_name: str, config_name: str):
-    """Hub fetch seam (requires network; offline callers inject examples)."""
-    from datasets import load_dataset  # deferred: heavy + networked
-
-    ds = load_dataset(dataset_name, config_name)
-    return list(ds["train"]), list(ds["validation"])
-
-
 def resolve_tokenizer(tokenizer_path: str, model_checkpoint: str):
     """Load the tokenizer from --tokenizer_path, falling back to the
     checkpoint dir; fail with a clear message rather than an opaque
@@ -153,16 +152,29 @@ def load_backbone_params(model_checkpoint: str):
     return None if ckpt is None else ckpt[1]["params"]
 
 
+def resolve_model_config(model_size: str, vocab_size: int, max_seq_length: int):
+    """--model_size -> AlbertConfig, vocab sized to the tokenizer (the
+    reference resizes embeddings for the Bengali vocab the same way,
+    sahajbert/run_first_peer.py:76-77). A position table grown past the
+    constructor default only applies to fresh backbones — warm starts are
+    shape-checked against the checkpoint in driver.finetune."""
+    ctor = AlbertConfig.named(model_size)
+    cfg = ctor(vocab_size=vocab_size)
+    if cfg.max_position_embeddings < max_seq_length:
+        cfg = ctor(vocab_size=vocab_size, max_position_embeddings=max_seq_length)
+    return cfg
+
+
 def main(argv=None) -> None:
     args = parse_config(NerArguments, argv)
-    train_examples, eval_examples = load_wikiann_bn(
+    train_examples, eval_examples = load_split_examples(
         args.dataset_name, args.dataset_config_name
     )
     tok = resolve_tokenizer(args.tokenizer_path, args.model_checkpoint)
     init_params = load_backbone_params(args.model_checkpoint)
     _, history = run_ner(
         args,
-        AlbertConfig.large(),
+        resolve_model_config(args.model_size, tok.vocab_size, args.max_seq_length),
         train_examples,
         eval_examples,
         tok.tokenize_words,

@@ -71,6 +71,18 @@ class AlbertConfig:
     ring_axis: str = "seq"
 
     @staticmethod
+    def named(model_size: str):
+        """The one model_size -> config-constructor resolver (role CLIs and
+        fine-tune CLIs must agree on names and fail the same way)."""
+        ctors = {"tiny": AlbertConfig.tiny, "large": AlbertConfig.large}
+        if model_size not in ctors:
+            raise ValueError(
+                f"unknown model_size {model_size!r} "
+                f"(expected one of {sorted(ctors)})"
+            )
+        return ctors[model_size]
+
+    @staticmethod
     def large(**overrides) -> "AlbertConfig":
         return AlbertConfig(**overrides)
 

@@ -50,8 +50,7 @@ def build_model(
         overrides["vocab_size"] = vocab_size
     if ring_mesh is not None:
         overrides["ring_mesh"] = ring_mesh
-    make = AlbertConfig.tiny if model_size == "tiny" else AlbertConfig.large
-    cfg = make(**overrides)
+    cfg = AlbertConfig.named(model_size)(**overrides)
     return cfg, AlbertForPreTraining(cfg)
 
 

@@ -180,6 +180,119 @@ def test_finetune_warm_start_uses_pretrained_backbone(rng):
     assert np.allclose(np.asarray(leaf), 0.123)
 
 
+def _write_jsonl(path, rows):
+    import json
+
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def _tiny_tokenizer_file(tmp_path):
+    from dedloc_tpu.data.tokenizer import FastTokenizer, train_unigram_tokenizer
+
+    corpus = [
+        "kolkata news story about sports",
+        "national desk reports state politics",
+        "entertainment world update international",
+    ] * 4
+    tok = FastTokenizer(train_unigram_tokenizer(corpus, vocab_size=200))
+    path = str(tmp_path / "tokenizer.json")
+    tok.save(path)
+    return path
+
+
+def test_ner_main_real_datasets_path(tmp_path, rng):
+    """Drive the NER CLI main end-to-end through the genuine
+    ``datasets.load_dataset`` ingestion (local data-files dir — the same
+    Arrow path the networked wikiann/bn fetch takes, train_ner.py)."""
+    from dedloc_tpu.finetune import ner
+
+    ds_dir = tmp_path / "wikiann_local"
+    ds_dir.mkdir()
+    rows = [
+        {"tokens": ["kolkata", "reports", "sports"], "ner_tags": [5, 0, 0]},
+        {"tokens": ["national", "desk"], "ner_tags": [3, 4]},
+        {"tokens": ["state", "politics", "update"], "ner_tags": [0, 0, 0]},
+        {"tokens": ["world", "news"], "ner_tags": [1, 2]},
+    ]
+    _write_jsonl(ds_dir / "train.jsonl", rows * 3)
+    _write_jsonl(ds_dir / "validation.jsonl", rows)
+
+    ner.main([
+        "--dataset_name", str(ds_dir),
+        "--model_size", "tiny",
+        "--max_seq_length", "32",
+        "--tokenizer_path", _tiny_tokenizer_file(tmp_path),
+        "--train.num_train_epochs", "1",
+        "--train.per_device_batch_size", "4",
+        "--train.learning_rate", "1e-3",
+    ])
+
+
+def test_ncc_main_real_datasets_path(tmp_path):
+    """Same for the NCC CLI (indic_glue sna.bn shape: text + label)."""
+    from dedloc_tpu.finetune import ncc
+
+    ds_dir = tmp_path / "sna_local"
+    ds_dir.mkdir()
+    rows = [
+        {"text": "kolkata news story about sports", "label": 4},
+        {"text": "national desk reports state politics", "label": 2},
+        {"text": "entertainment world update", "label": 5},
+        {"text": "international desk update", "label": 3},
+    ]
+    _write_jsonl(ds_dir / "train.jsonl", rows * 3)
+    _write_jsonl(ds_dir / "validation.jsonl", rows)
+
+    ncc.main([
+        "--dataset_name", str(ds_dir),
+        "--model_size", "tiny",
+        "--max_seq_length", "24",
+        "--tokenizer_path", _tiny_tokenizer_file(tmp_path),
+        "--train.num_train_epochs", "1",
+        "--train.per_device_batch_size", "4",
+        "--train.learning_rate", "1e-3",
+    ])
+
+
+def test_finetune_warm_start_rejects_shape_mismatch():
+    """A checkpoint whose backbone doesn't match the config (e.g. a smaller
+    position table than --max_seq_length needs) must error, not silently
+    clamp positions under jit."""
+    import jax
+
+    from dedloc_tpu.finetune.driver import finetune
+    from dedloc_tpu.models.albert import AlbertForSequenceClassification
+
+    small = AlbertConfig.tiny(vocab_size=64, max_position_embeddings=16)
+    ckpt_model = AlbertForSequenceClassification(small, num_labels=2)
+    ckpt_params = ckpt_model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 16), np.int32)
+    )["params"]
+
+    grown = AlbertConfig.tiny(vocab_size=64, max_position_embeddings=32)
+    model = AlbertForSequenceClassification(grown, num_labels=2)
+    data = {
+        "input_ids": np.ones((4, 32), np.int32),
+        "attention_mask": np.ones((4, 32), np.int32),
+        "labels": np.array([0, 1, 0, 1], np.int32),
+    }
+    args = FinetuneArguments(num_train_epochs=0, per_device_batch_size=4)
+    with pytest.raises(ValueError, match="position table|model config"):
+        finetune(model, {"albert": ckpt_params["albert"]}, data, data, args)
+
+
+def test_model_size_resolver_is_strict():
+    from dedloc_tpu.models.albert import AlbertConfig as C
+
+    assert C.named("tiny") is C.tiny and C.named("large") is C.large
+    with pytest.raises(ValueError, match="unknown model_size"):
+        C.named("larg")
+    with pytest.raises(ValueError, match="unknown model_size"):
+        C.named("vocab_size")  # class attribute, but not a size
+
+
 def test_encode_truncation_preserves_sep():
     from dedloc_tpu.finetune.ncc import encode_ncc_examples
     from dedloc_tpu.finetune.ner import encode_ner_examples
