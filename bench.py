@@ -328,7 +328,12 @@ def main() -> None:
     # Round-4 recipe: fused add+LN Pallas kernel + the fused_ln remat policy,
     # micro-batch 12 (the B sweep's sweet spot — small enough that XLA stops
     # inserting remat-compression copies, large enough to feed the MXU;
-    # 8/10/14/16/24/32 all measured slower, BASELINE.md round-4 notes).
+    # 8/10/14/16/24/32 all measured slower, BASELINE.md round-4 notes) and
+    # 16 accumulation micro-batches per jitted step: the ~10 ms of per-step
+    # plumbing (donated-state shuffling + LAMB apply) amortizes over 8x the
+    # samples vs accum 2 (108.4 -> 112.3 samples/s; accum 32 adds only +0.4
+    # more). Production-honest: one optimizer step at target_batch_size 4096
+    # accumulates far more than 16 micro-batches per chip.
     remat = os.environ.get("DEDLOC_BENCH_REMAT", "fused_ln")
     # the fused_ln policy only makes sense with the fused add+LN kernel on
     fused_ln = remat == "fused_ln"
@@ -342,9 +347,12 @@ def main() -> None:
                                  fused_ln=fused_ln)
         # iters per block: one scalar readback (~90 ms tunnel RTT) per block,
         # so longer blocks report closer to the true device rate
-        accum, per_step, seq, iters = 2, 12, 512, 10
+        accum, per_step, seq, iters = 16, 12, 512, 10
     if per_step_env:
         per_step = per_step_env
+    accum_env = int(os.environ.get("DEDLOC_BENCH_ACCUM", "0"))
+    if accum_env:
+        accum = accum_env
     # gathered masked-position MLM head: vocab projection only where labels
     # exist (~15% of positions) — the TPU-native layout
     from dedloc_tpu.data.mlm import max_predictions_for

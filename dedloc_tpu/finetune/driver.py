@@ -32,31 +32,36 @@ def load_split_examples(dataset_name: str, config_name: str):
     explicitly (``data_files``) so unrelated files living in the same dir —
     a tokenizer.json, checkpoints — don't get swept into the dataset by
     module inference."""
-    import glob
     import os
 
     from datasets import load_dataset  # deferred: heavy + networked
 
     if os.path.isdir(dataset_name):
-        def split_files(*stems):
-            # exact stems only — train*.json* would sweep a train_log.jsonl
-            # run log into the training split
-            return sorted(
-                p
-                for stem in stems
-                for p in glob.glob(os.path.join(dataset_name, f"{stem}.json*"))
+        if config_name:
+            logger.info(
+                "dataset config %r ignored for local data-files dir %s",
+                config_name,
+                dataset_name,
+            )
+
+        def split_file(*stems):
+            # exact names only (train*.json* would sweep a train_log.jsonl
+            # run log or a .json.bak backup into the split); first matching
+            # stem wins so validation.jsonl shadows a stale val.jsonl
+            for stem in stems:
+                for ext in (".jsonl", ".json"):
+                    path = os.path.join(dataset_name, stem + ext)
+                    if os.path.exists(path):
+                        return path
+            raise FileNotFoundError(
+                f"{dataset_name} has no {stems[0]} data file (expected one "
+                f"of: {', '.join(s + e for s in stems for e in ('.jsonl', '.json'))})"
             )
 
         data_files = {
-            "train": split_files("train"),
-            "validation": split_files("validation", "val"),
+            "train": split_file("train"),
+            "validation": split_file("validation", "val"),
         }
-        missing = [k for k, v in data_files.items() if not v]
-        if missing:
-            raise FileNotFoundError(
-                f"{dataset_name} has no {'/'.join(missing)} data files "
-                "(expected train*.json[l] and valid*.json[l])"
-            )
         ds = load_dataset("json", data_files=data_files)
     else:
         ds = load_dataset(dataset_name, config_name)

@@ -13,7 +13,11 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from dedloc_tpu.core.config import parse_config
-from dedloc_tpu.finetune.driver import FinetuneArguments, finetune
+from dedloc_tpu.finetune.driver import (
+    FinetuneArguments,
+    finetune,
+    load_split_examples,
+)
 from dedloc_tpu.finetune.metrics import accuracy_score
 from dedloc_tpu.models.albert import AlbertConfig, AlbertForSequenceClassification
 
@@ -105,9 +109,10 @@ def run_ncc(
 
 
 def main(argv=None) -> None:
-    args = parse_config(NccArguments, argv)
-    from dedloc_tpu.finetune.driver import load_split_examples
+    from dedloc_tpu.roles.common import force_cpu_if_requested
 
+    force_cpu_if_requested()
+    args = parse_config(NccArguments, argv)
     train_examples, eval_examples = load_split_examples(
         args.dataset_name, args.dataset_config_name
     )

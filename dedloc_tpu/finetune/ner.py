@@ -166,6 +166,9 @@ def resolve_model_config(model_size: str, vocab_size: int, max_seq_length: int):
 
 
 def main(argv=None) -> None:
+    from dedloc_tpu.roles.common import force_cpu_if_requested
+
+    force_cpu_if_requested()
     args = parse_config(NerArguments, argv)
     train_examples, eval_examples = load_split_examples(
         args.dataset_name, args.dataset_config_name

@@ -26,8 +26,14 @@ logger = get_logger(__name__)
 
 def force_cpu_if_requested() -> None:
     """Multi-process drives must not contend for the single TPU chip: set
-    DEDLOC_FORCE_CPU=1 in each peer subprocess (the chip is exclusive)."""
-    if os.environ.get("DEDLOC_FORCE_CPU") == "1":
+    DEDLOC_FORCE_CPU=1 (or JAX_PLATFORMS=cpu) in each peer subprocess (the
+    chip is exclusive). JAX_PLATFORMS must be re-applied through jax.config
+    because a container sitecustomize may pin the TPU plugin after env-var
+    processing — the env var alone silently loses."""
+    if (
+        os.environ.get("DEDLOC_FORCE_CPU") == "1"
+        or os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    ):
         jax.config.update("jax_platforms", "cpu")
 
 

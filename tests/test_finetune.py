@@ -283,6 +283,24 @@ def test_finetune_warm_start_rejects_shape_mismatch():
         finetune(model, {"albert": ckpt_params["albert"]}, data, data, args)
 
 
+def test_force_cpu_honors_jax_platforms_env(monkeypatch):
+    """JAX_PLATFORMS=cpu must be re-applied via jax.config (a sitecustomize
+    can pin the TPU plugin after env processing): the fleet scripts and
+    fine-tune CLIs rely on it to stay off the exclusive chip."""
+    import jax
+
+    from dedloc_tpu.roles.common import force_cpu_if_requested
+
+    monkeypatch.setenv("JAX_PLATFORMS", "CPU ")  # case/space-insensitive
+    monkeypatch.delenv("DEDLOC_FORCE_CPU", raising=False)
+    before = jax.config.jax_platforms
+    try:
+        force_cpu_if_requested()
+        assert jax.config.jax_platforms == "cpu"
+    finally:
+        jax.config.update("jax_platforms", before)
+
+
 def test_model_size_resolver_is_strict():
     from dedloc_tpu.models.albert import AlbertConfig as C
 
