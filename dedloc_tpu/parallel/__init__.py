@@ -1,4 +1,9 @@
 from dedloc_tpu.parallel.mesh import make_mesh, shard_batch, replicate
+from dedloc_tpu.parallel.pipeline import (
+    pipeline_apply,
+    shared_stage_fn,
+    stage_param_sharding,
+)
 from dedloc_tpu.parallel.train_step import (
     TrainState,
     make_accumulate_step,
