@@ -1,4 +1,10 @@
 from dedloc_tpu.parallel.mesh import make_mesh, shard_batch, replicate
+from dedloc_tpu.parallel.moe import (
+    MoEConfig,
+    expert_param_sharding,
+    init_moe_params,
+    moe_ffn,
+)
 from dedloc_tpu.parallel.pipeline import (
     pipeline_apply,
     shared_stage_fn,

@@ -1,0 +1,135 @@
+"""Expert parallelism: Switch-style mixture-of-experts FFN, TPU-native.
+
+The reference repo has no MoE (SURVEY.md §2.5: EP "out of scope" — though
+hivemind, the library it builds on, began life as a decentralized
+mixture-of-experts system). This module supplies the EP axis the TPU
+framework would use for sparse scaling: experts shard over a mesh axis and
+the token shuffle lowers to XLA all-to-alls, in the classic GShard/Switch
+dispatch-einsum formulation — no hand-written collectives, the sharding
+annotations alone place the communication on ICI.
+
+Design (top-1 / Switch routing, jit-exact and static-shaped):
+- router logits -> softmax gate, top-1 expert per token;
+- capacity C = ceil(T / E · capacity_factor): each expert processes at most
+  C tokens per batch, tokens beyond capacity fall through on the residual
+  path (standard Switch behavior; static shapes are what the MXU wants);
+- dispatch/combine as one-hot einsums: ``[T,E,C]`` masks against token
+  activations — under pjit with ``wi/wo`` sharded ``P(axis)`` and tokens
+  sharded over data, XLA inserts the all-to-alls;
+- auxiliary load-balancing loss (mean gate · mean assignment per expert,
+  scaled by E) exactly as in Switch, returned for the trainer to add.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    hidden_size: int
+    ffn_size: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+
+def init_moe_params(cfg: MoEConfig, rng: jax.Array) -> Dict[str, jnp.ndarray]:
+    """Router + per-expert FFN stacks (leading expert axis — shard it with
+    ``expert_param_sharding`` so each device holds E/n experts)."""
+    kr, ki, ko = jax.random.split(rng, 3)
+    scale_in = 1.0 / math.sqrt(cfg.hidden_size)
+    scale_out = 1.0 / math.sqrt(cfg.ffn_size)
+    return {
+        "router": (
+            jax.random.normal(kr, (cfg.hidden_size, cfg.num_experts)) * scale_in
+        ).astype(jnp.float32),
+        "wi": (
+            jax.random.normal(
+                ki, (cfg.num_experts, cfg.hidden_size, cfg.ffn_size)
+            ) * scale_in
+        ).astype(cfg.dtype),
+        "wo": (
+            jax.random.normal(
+                ko, (cfg.num_experts, cfg.ffn_size, cfg.hidden_size)
+            ) * scale_out
+        ).astype(cfg.dtype),
+    }
+
+
+def expert_param_sharding(mesh: Mesh, axis: str = "expert"):
+    """Pytree of shardings for ``init_moe_params`` output: experts split
+    over ``axis``, the router replicated."""
+    return {
+        "router": NamedSharding(mesh, P()),
+        "wi": NamedSharding(mesh, P(axis)),
+        "wo": NamedSharding(mesh, P(axis)),
+    }
+
+
+def moe_ffn(
+    params: Dict[str, jnp.ndarray],
+    x: jnp.ndarray,  # [T, H] tokens (flatten batch x seq first)
+    cfg: MoEConfig,
+    mesh: Optional[Mesh] = None,
+    axis: str = "expert",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (y [T, H], aux_loss scalar). Over-capacity tokens pass
+    through as zeros (add the residual connection outside).
+
+    With ``mesh``, intermediate expert blocks are sharding-constrained to
+    ``P(axis)`` so the dispatched tokens travel to their expert's device
+    (the all-to-all) and the FFN runs expert-local.
+    """
+    T = x.shape[0]
+    E = cfg.num_experts
+    capacity = max(1, math.ceil(T / E * cfg.capacity_factor))
+
+    gate_logits = x.astype(jnp.float32) @ params["router"]  # [T, E]
+    gates = jax.nn.softmax(gate_logits, axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)  # [T]
+    gate = jnp.take_along_axis(gates, expert_idx[:, None], axis=-1)[:, 0]
+
+    assign = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, E]
+    # position of each token within its expert's queue (0-based)
+    position = jnp.cumsum(assign, axis=0) * assign - 1.0
+    in_capacity = (position < capacity) & (assign > 0)
+    pos_in_expert = jnp.clip(position, 0, capacity - 1).astype(jnp.int32)
+
+    # Switch aux loss: E * Σ_e (fraction of tokens on e) · (mean gate for e)
+    density = jnp.mean(assign, axis=0)
+    density_proxy = jnp.mean(gates, axis=0)
+    aux_loss = E * jnp.sum(density * density_proxy)
+
+    # [T, E, C] dispatch mask (in_capacity already excludes non-assigned
+    # slots); combine carries the gate weight
+    dispatch = (
+        in_capacity.astype(jnp.float32)[:, :, None]
+        * jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)
+    )
+    combine = dispatch * gate[:, None, None]
+
+    # tokens -> expert blocks (the all-to-all when experts are sharded)
+    expert_in = jnp.einsum(
+        "tec,th->ech", dispatch.astype(cfg.dtype), x.astype(cfg.dtype)
+    )
+    if mesh is not None:
+        expert_in = jax.lax.with_sharding_constraint(
+            expert_in, NamedSharding(mesh, P(axis))
+        )
+    h = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in, params["wi"]))
+    expert_out = jnp.einsum("ecf,efh->ech", h, params["wo"])
+    if mesh is not None:
+        expert_out = jax.lax.with_sharding_constraint(
+            expert_out, NamedSharding(mesh, P(axis))
+        )
+    # expert blocks -> tokens (the reverse all-to-all), gate-weighted
+    y = jnp.einsum(
+        "tec,ech->th", combine.astype(cfg.dtype), expert_out
+    )
+    return y.astype(x.dtype), aux_loss

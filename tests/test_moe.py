@@ -1,0 +1,101 @@
+"""Expert-parallel MoE: the dispatch-einsum layer must agree exactly with a
+per-token reference (top-1 routing + capacity semantics), sharded == local."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dedloc_tpu.parallel.mesh import make_mesh
+from dedloc_tpu.parallel.moe import (
+    MoEConfig,
+    expert_param_sharding,
+    init_moe_params,
+    moe_ffn,
+)
+
+CFG = MoEConfig(hidden_size=8, ffn_size=16, num_experts=4, capacity_factor=1.0)
+
+
+def _reference(params, x, cfg):
+    """Per-token loop: top-1 expert, first-come capacity, gate-weighted FFN."""
+    T = x.shape[0]
+    capacity = max(1, math.ceil(T / cfg.num_experts * cfg.capacity_factor))
+    gates = jax.nn.softmax(x.astype(jnp.float32) @ params["router"], axis=-1)
+    counts = [0] * cfg.num_experts
+    out = np.zeros_like(np.asarray(x), dtype=np.float32)
+    for t in range(T):
+        e = int(jnp.argmax(gates[t]))
+        if counts[e] >= capacity:
+            continue
+        counts[e] += 1
+        h = jax.nn.gelu(x[t] @ params["wi"][e])
+        out[t] = float(gates[t, e]) * np.asarray(h @ params["wo"][e])
+    return out
+
+
+def test_moe_matches_per_token_reference(rng):
+    params = init_moe_params(CFG, jax.random.PRNGKey(0))
+    x = jnp.asarray(rng.normal(0, 1, (12, CFG.hidden_size)), jnp.float32)
+    y, _ = jax.jit(lambda p, v: moe_ffn(p, v, CFG))(params, x)
+    np.testing.assert_allclose(
+        np.asarray(y), _reference(params, x, CFG), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_moe_capacity_drops_tokens():
+    """With capacity 1 and a router forced onto one expert, only the first
+    token gets computed — the rest ride the residual path (zeros here)."""
+    cfg = MoEConfig(hidden_size=4, ffn_size=8, num_experts=2, capacity_factor=0.5)
+    params = init_moe_params(cfg, jax.random.PRNGKey(1))
+    params["router"] = jnp.zeros_like(params["router"]).at[:, 0].set(10.0)
+    x = jnp.ones((4, cfg.hidden_size), jnp.float32)
+    y, _ = moe_ffn(params, x, cfg)
+    assert np.any(np.asarray(y[0]) != 0)
+    np.testing.assert_array_equal(np.asarray(y[1:]), 0)
+
+
+def test_moe_aux_loss_balanced_is_one():
+    """Switch aux loss equals 1.0 under perfectly uniform routing."""
+    cfg = MoEConfig(hidden_size=4, ffn_size=8, num_experts=4)
+    params = init_moe_params(cfg, jax.random.PRNGKey(2))
+    params["router"] = jnp.zeros_like(params["router"])  # uniform gates
+    # argmax breaks ties to expert 0 -> density is NOT uniform, but the
+    # gate-probability proxy is, so loss = E * sum(density * 1/E) = 1
+    x = jnp.asarray(np.random.default_rng(0).normal(0, 1, (16, 4)), jnp.float32)
+    _, aux = moe_ffn(params, x, cfg)
+    assert float(aux) == pytest.approx(1.0, rel=1e-5)
+
+
+def test_moe_expert_sharded_matches_local(rng):
+    """Experts sharded over a 4-device mesh axis (params 1/4 per device,
+    dispatch riding XLA collectives) == the unsharded computation."""
+    mesh = make_mesh(4, axis_names=("expert",))
+    params = init_moe_params(CFG, jax.random.PRNGKey(3))
+    x = jnp.asarray(rng.normal(0, 1, (16, CFG.hidden_size)), jnp.float32)
+
+    y_local, aux_local = moe_ffn(params, x, CFG)
+
+    sharded = jax.device_put(params, expert_param_sharding(mesh))
+    assert sharded["wi"].addressable_shards[0].data.shape[0] == 1
+    y_sh, aux_sh = jax.jit(
+        lambda p, v: moe_ffn(p, v, CFG, mesh=mesh)
+    )(sharded, x)
+    np.testing.assert_allclose(np.asarray(y_sh), np.asarray(y_local), rtol=2e-5)
+    assert float(aux_sh) == pytest.approx(float(aux_local), rel=1e-5)
+
+
+def test_moe_gradients_flow_everywhere(rng):
+    params = init_moe_params(CFG, jax.random.PRNGKey(4))
+    x = jnp.asarray(rng.normal(0, 1, (12, CFG.hidden_size)), jnp.float32)
+
+    def loss(p):
+        y, aux = moe_ffn(p, x, CFG)
+        return jnp.mean(y**2) + 0.01 * aux
+
+    g = jax.jit(jax.grad(loss))(params)
+    for k in ("router", "wi", "wo"):
+        arr = np.asarray(g[k], np.float32)
+        assert np.isfinite(arr).all()
+        assert np.abs(arr).max() > 0, f"no gradient reached {k}"
