@@ -335,8 +335,9 @@ def main() -> None:
     # more). Production-honest: one optimizer step at target_batch_size 4096
     # accumulates far more than 16 micro-batches per chip.
     remat = os.environ.get("DEDLOC_BENCH_REMAT", "fused_ln")
-    # the fused_ln policy only makes sense with the fused add+LN kernel on
-    fused_ln = remat == "fused_ln"
+    from dedloc_tpu.models.albert import fused_ln_for_policy
+
+    fused_ln = fused_ln_for_policy(remat)
     per_step_env = int(os.environ.get("DEDLOC_BENCH_BATCH", "0"))
     if tiny:  # CI smoke on CPU
         cfg = AlbertConfig.tiny(remat_policy=remat, attention_impl=impl,

@@ -249,11 +249,22 @@ class AlbertLayer(nn.Module):
         ffn = checkpoint_name(
             _dense(cfg.intermediate_size, cfg, "ffn")(hidden), "ffn_up"
         )
-        ffn = nn.gelu(ffn, approximate=True)
+        # also named so fused_ln_gelu can save the activation output and
+        # skip the gelu forward replay in the remat backward (naming is
+        # free for policies that don't reference it)
+        ffn = checkpoint_name(nn.gelu(ffn, approximate=True), "ffn_gelu")
         ffn = _dense(cfg.hidden_size, cfg, "ffn_output")(ffn)
         if cfg.hidden_dropout_prob > 0.0 and not deterministic:
             ffn = nn.Dropout(cfg.hidden_dropout_prob)(ffn, deterministic=deterministic)
         return AddLayerNorm(cfg, name="layernorm")(ffn, hidden)
+
+
+def fused_ln_for_policy(remat_policy: str) -> bool:
+    """Policy -> whether the fused add+LN Pallas kernel must be on: the
+    fused_ln* saved sets only cover the backward when the kernel produces
+    the (y, x̂, rstd) outputs they rely on. One source of truth for every
+    builder (bench, roles, profiler)."""
+    return remat_policy.startswith("fused_ln")
 
 
 def _pallas_outputs_saveable(prim, *_, **__) -> bool:
@@ -299,6 +310,19 @@ class _ScannedAlbertLayer(nn.Module):
                     jax.checkpoint_policies.save_from_both_policies(
                         jax.checkpoint_policies.save_only_these_names(
                             "flash_qkv", "ffn_up"
+                        ),
+                        _pallas_outputs_saveable,
+                    )
+                ),
+                # fused_ln + the gelu output: the backward's one remaining
+                # forward replay (gelu of the FFN up-projection) runs from a
+                # saved residual instead — costs [B,S,intermediate] bf16 per
+                # layer iteration of extra HBM (ffn_up stays saved: gelu's
+                # VJP still needs its primal input)
+                "fused_ln_gelu": (
+                    jax.checkpoint_policies.save_from_both_policies(
+                        jax.checkpoint_policies.save_only_these_names(
+                            "flash_qkv", "ffn_up", "ffn_gelu"
                         ),
                         _pallas_outputs_saveable,
                     )

@@ -47,9 +47,9 @@ def build_model(
     overrides = {}
     if remat_policy:
         overrides["remat_policy"] = remat_policy
-        # the fused_ln policy's saved set only covers the backward when the
-        # fused add+LN kernel produces it — the two are one recipe
-        overrides["fused_ln"] = remat_policy == "fused_ln"
+        from dedloc_tpu.models.albert import fused_ln_for_policy
+
+        overrides["fused_ln"] = fused_ln_for_policy(remat_policy)
     if attention_impl:
         overrides["attention_impl"] = attention_impl
     if vocab_size:

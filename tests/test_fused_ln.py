@@ -64,13 +64,16 @@ def test_residual_branches_get_identical_cotangent(rng):
     np.testing.assert_allclose(dx, dr, atol=1e-6)
 
 
-def test_model_fused_ln_matches_unfused(rng):
-    """AlbertForPreTraining with fused_ln=True + the fused_ln remat policy
-    produces the same loss and gradients as the unfused reference path."""
+@pytest.mark.parametrize("policy", ["fused_ln", "fused_ln_gelu"])
+def test_model_fused_ln_matches_unfused(rng, policy):
+    """AlbertForPreTraining with fused_ln=True + a fused_ln* remat policy
+    (fused_ln_gelu additionally saves the gelu output, skipping its backward
+    replay) produces the same loss and gradients as the unfused path."""
     from dedloc_tpu.models.albert import (
         AlbertConfig,
         AlbertForPreTraining,
         albert_pretraining_loss,
+        fused_ln_for_policy,
     )
 
     ids = jnp.asarray(rng.integers(0, 512, (2, 64)), jnp.int32)
@@ -79,16 +82,16 @@ def test_model_fused_ln_matches_unfused(rng):
     )
     sop = jnp.asarray(rng.integers(0, 2, (2,)), jnp.int32)
 
-    def build(fused):
+    def build(remat_policy):
         cfg = AlbertConfig.tiny(
             dtype=jnp.float32,
             attention_impl="flash",
-            remat_policy="fused_ln" if fused else "dots_no_batch_attn",
-            fused_ln=fused,
+            remat_policy=remat_policy,
+            fused_ln=fused_ln_for_policy(remat_policy),
         )
         return cfg, AlbertForPreTraining(cfg)
 
-    cfg0, model0 = build(False)
+    cfg0, model0 = build("dots_no_batch_attn")
     params = model0.init(jax.random.PRNGKey(0), ids)["params"]
 
     def loss_fn(model):
@@ -99,7 +102,8 @@ def test_model_fused_ln_matches_unfused(rng):
 
         return f
 
-    cfg1, model1 = build(True)
+    cfg1, model1 = build(policy)
+    assert cfg1.fused_ln
     l0, g0 = jax.value_and_grad(loss_fn(model0))(params)
     l1, g1 = jax.value_and_grad(loss_fn(model1))(params)
     np.testing.assert_allclose(l1, l0, atol=1e-5, rtol=1e-5)

@@ -177,10 +177,14 @@ def run_pieces(peak):
 
 
 def make_model(remat_policy, impl):
-    from dedloc_tpu.models.albert import AlbertConfig, AlbertForPreTraining
+    from dedloc_tpu.models.albert import (
+        AlbertConfig,
+        AlbertForPreTraining,
+        fused_ln_for_policy,
+    )
 
     cfg = AlbertConfig.large(remat_policy=remat_policy, attention_impl=impl,
-                             fused_ln=remat_policy == "fused_ln")
+                             fused_ln=fused_ln_for_policy(remat_policy))
     return AlbertForPreTraining(cfg), cfg
 
 
