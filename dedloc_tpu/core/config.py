@@ -189,9 +189,11 @@ class TrainingArguments:
     (albert/arguments.py:104-128)."""
 
     model_size: str = "large"  # tiny (CI fixture) | large
-    # override model remat: nothing|dots|dots_no_batch|dots_no_batch_attn
-    # (dots_no_batch_attn additionally saves flash-attention residuals — the
-    # fastest measured policy for the seq-512 recipe on v5e; models/albert.py)
+    # override model remat: nothing|dots|dots_no_batch|dots_no_batch_attn|
+    # fused_ln|fused_ln_gelu (fused_ln — saved Pallas outputs + named
+    # matmuls, pairs the fused add+LN kernel on automatically — is the
+    # fastest measured policy for the seq-512 recipe on v5e; the policy
+    # table lives in models/albert.py, measurements in docs/perf.md)
     remat_policy: str = ""
     attention_impl: str = ""  # override: dense|blockwise|flash|ring
     vocab_size: int = 0  # override model vocab (0 = size default); must cover

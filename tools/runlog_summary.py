@@ -1,0 +1,76 @@
+"""Summarize a trainer train_log.jsonl into the BASELINE.md table format.
+
+Usage:
+    python tools/runlog_summary.py train_log.jsonl [step step ...]
+
+Prints a markdown `| global step | wall (min) | loss |` table at the given
+checkpoints (default: a log-spaced selection plus the final step) and the
+phase-telemetry percentiles (boundary/data-wait/allreduce/seam) the trainer
+records per global step.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def load(path):
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def pick_steps(rows, requested):
+    steps = {r["step"] for r in rows}
+    if requested:
+        missing = [s for s in requested if s not in steps]
+        if missing:
+            print(f"warning: requested steps not in log: {missing}",
+                  file=sys.stderr)
+        return [s for s in requested if s in steps]
+    last = rows[-1]["step"]
+    marks = [1, 10, 25, 50, 100, 200, 300, 500, 700, 1000, 1330, 1500, 2000,
+             2500, 3000, 3500, 4000]
+    out = [s for s in marks if s in steps and s < last]
+    return out + [last]
+
+
+def percentiles(values):
+    if not values:
+        return (0.0, 0.0, 0.0)
+    s = sorted(values)
+
+    def pct(p):
+        return s[min(len(s) - 1, int(p * len(s)))]
+
+    return pct(0.50), pct(0.90), pct(0.99)
+
+
+def main(argv):
+    rows = load(argv[0])
+    requested = [int(a) for a in argv[1:]]
+    by_step = {r["step"]: r for r in rows}
+    t0 = rows[0]["wall_s"] - rows[0].get("step_wall_s", 0.0)
+
+    print("| global step | wall (min) | train loss |")
+    print("|---|---|---|")
+    for s in pick_steps(rows, requested):
+        r = by_step[s]
+        print(f"| {s} | {(r['wall_s'] - t0) / 60:.1f} | {r['loss']:.3f} |")
+
+    for key in ("boundary_ms", "data_wait_ms", "allreduce_ms", "seam_ms"):
+        vals = [r[key] for r in rows[5:] if key in r]
+        if vals and isinstance(vals[0], dict):  # seam_ms: per-phase subkeys
+            subs = sorted({sub for v in vals for sub in v})
+            for sub in subs:
+                p50, p90, p99 = percentiles([v[sub] for v in vals if sub in v])
+                print(f"{key}.{sub}: p50/p90/p99 = "
+                      f"{p50:.0f}/{p90:.0f}/{p99:.0f} ms")
+            continue
+        p50, p90, p99 = percentiles(vals)
+        print(f"{key}: p50/p90/p99 = {p50:.0f}/{p90:.0f}/{p99:.0f} ms")
+    mins = (rows[-1]["wall_s"] - t0) / 60
+    print(f"total: {rows[-1]['step']} global steps in {mins:.0f} min wall")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
