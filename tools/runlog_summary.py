@@ -16,7 +16,21 @@ import sys
 
 def load(path):
     with open(path, encoding="utf-8") as f:
-        return [json.loads(line) for line in f if line.strip()]
+        rows = [json.loads(line) for line in f if line.strip()]
+    # wall_s is per-process: a checkpoint-resume starts a new segment whose
+    # clock restarts. Rebase each segment so wall_s accumulates run-wide.
+    # A regressing/repeating step counter is the robust resume signal (the
+    # new process may log a first wall_s larger than the old one's last);
+    # a wall_s drop catches same-step restarts.
+    offset, prev_wall, prev_step = 0.0, None, None
+    for r in rows:
+        if prev_wall is not None and (
+            r["wall_s"] < prev_wall or r["step"] <= prev_step
+        ):
+            offset += prev_wall
+        prev_wall, prev_step = r["wall_s"], r["step"]
+        r["wall_s"] += offset
+    return rows
 
 
 def pick_steps(rows, requested):
