@@ -1,0 +1,54 @@
+"""tools/runlog_summary.py: the wall-clock rebasing across checkpoint-resume
+segments must detect both resume signatures (step regression with a LARGER
+first wall_s, and same-step restarts with a wall_s drop) — BASELINE.md
+tables are built from its output."""
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+spec = importlib.util.spec_from_file_location(
+    "runlog_summary",
+    Path(__file__).resolve().parent.parent / "tools" / "runlog_summary.py",
+)
+runlog_summary = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(runlog_summary)
+
+
+def _write(tmp_path, rows):
+    p = tmp_path / "log.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    return str(p)
+
+
+def test_resume_rebases_wall_clock_on_step_regression(tmp_path):
+    """Second segment replays steps (resume from an older checkpoint) and
+    its first wall_s EXCEEDS the first segment's last — the step counter,
+    not the wall clock, must trigger the rebase."""
+    rows = [
+        {"wall_s": 10.0, "step": 1, "loss": 11.0},
+        {"wall_s": 40.0, "step": 5, "loss": 10.0},
+        # resume from checkpoint-3: step regresses, wall restarts HIGHER
+        {"wall_s": 46.8, "step": 4, "loss": 10.1},
+        {"wall_s": 60.0, "step": 6, "loss": 9.8},
+    ]
+    loaded = runlog_summary.load(_write(tmp_path, rows))
+    assert [round(r["wall_s"], 1) for r in loaded] == [10.0, 40.0, 86.8, 100.0]
+
+
+def test_resume_rebases_wall_clock_on_wall_drop(tmp_path):
+    rows = [
+        {"wall_s": 100.0, "step": 10, "loss": 9.0},
+        {"wall_s": 5.0, "step": 11, "loss": 8.9},  # restart, steps continue
+    ]
+    loaded = runlog_summary.load(_write(tmp_path, rows))
+    assert [r["wall_s"] for r in loaded] == [100.0, 105.0]
+
+
+def test_missing_requested_steps_warn(tmp_path, capsys):
+    rows = [{"wall_s": 1.0, "step": 1, "loss": 2.0}]
+    picked = runlog_summary.pick_steps(
+        runlog_summary.load(_write(tmp_path, rows)), [1, 500]
+    )
+    assert picked == [1]
+    assert "500" in capsys.readouterr().err

@@ -21,7 +21,11 @@ def load(path):
     # clock restarts. Rebase each segment so wall_s accumulates run-wide.
     # A regressing/repeating step counter is the robust resume signal (the
     # new process may log a first wall_s larger than the old one's last);
-    # a wall_s drop catches same-step restarts.
+    # a wall_s drop catches most same-step restarts. Known blind spot: a
+    # restart that both continues the step sequence AND logs a first wall_s
+    # above the prior segment's last (short segment + slow startup) is
+    # indistinguishable from a long between-steps gap in this schema — the
+    # prior segment's wall then goes uncounted.
     offset, prev_wall, prev_step = 0.0, None, None
     for r in rows:
         if prev_wall is not None and (
