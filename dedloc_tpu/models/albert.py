@@ -259,12 +259,19 @@ class AlbertLayer(nn.Module):
         return AddLayerNorm(cfg, name="layernorm")(ffn, hidden)
 
 
+#: The only policy names that engage the fused add+LN Pallas kernel; a
+#: membership test (not a prefix match) so a typo like "fused_ln_geluu"
+#: fails fast at the remat-policy table with "unknown remat_policy"
+#: instead of enabling the kernel and dying later on a bare KeyError.
+FUSED_LN_POLICIES = frozenset({"fused_ln", "fused_ln_gelu"})
+
+
 def fused_ln_for_policy(remat_policy: str) -> bool:
     """Policy -> whether the fused add+LN Pallas kernel must be on: the
     fused_ln* saved sets only cover the backward when the kernel produces
     the (y, x̂, rstd) outputs they rely on. One source of truth for every
     builder (bench, roles, profiler)."""
-    return remat_policy.startswith("fused_ln")
+    return remat_policy in FUSED_LN_POLICIES
 
 
 def _pallas_outputs_saveable(prim, *_, **__) -> bool:
@@ -327,7 +334,13 @@ class _ScannedAlbertLayer(nn.Module):
                         _pallas_outputs_saveable,
                     )
                 ),
-            }[self.cfg.remat_policy]
+            }
+            if self.cfg.remat_policy not in policy:
+                raise ValueError(
+                    f"unknown remat_policy {self.cfg.remat_policy!r}; "
+                    f"expected one of {sorted(policy)}"
+                )
+            policy = policy[self.cfg.remat_policy]
             layer_cls = nn.remat(AlbertLayer, policy=policy)
         out = layer_cls(self.cfg, self.deterministic, name="block")(hidden, attn_bias)
         return out, ()

@@ -65,6 +65,8 @@ def percentiles(values):
 
 def main(argv):
     rows = load(argv[0])
+    if not rows:
+        sys.exit(f"{argv[0]}: no log rows")
     requested = [int(a) for a in argv[1:]]
     by_step = {r["step"]: r for r in rows}
     t0 = rows[0]["wall_s"] - rows[0].get("step_wall_s", 0.0)
