@@ -101,6 +101,9 @@ class CollaborativeOptimizer:
         param_sharding=None,  # tensor-parallel layout (parallel.sharding)
         verbose: bool = False,
         listen_host: str = "0.0.0.0",
+        listen_port: int = 0,  # fixed averager port (0 = ephemeral); a
+        # listening averager doubles as a circuit relay, so public peers in
+        # relayed deployments want this pinned (--averager.listen_port)
         advertised_host: Optional[str] = None,
         post_apply: Optional[Callable[[TrainState], TrainState]] = None,
         authorizer=None,  # token authorizer for gated public runs
@@ -129,6 +132,7 @@ class CollaborativeOptimizer:
             averaging_timeout=averaging_timeout,
             target_group_size=target_group_size,
             listen_host=listen_host,
+            listen_port=listen_port,
             advertised_host=advertised_host,
             authorizer=authorizer,
             authority_public_key=authority_public_key,
@@ -271,14 +275,24 @@ class CollaborativeOptimizer:
             get_dht_time() - self._created_at
             >= self.tracker.metadata_expiration
         )
-        if collab.num_peers <= 1 and not self.client_mode and alone_grace:
-            # alone in the collaboration: the group all-reduce is the
-            # identity, so the gradients never leave the device — no
-            # device_get, no wire codec, no matchmaking window. A peer that
-            # joins later shows up in the tracker and the next boundary takes
-            # the full averaging path. (The reference pays hivemind's full
-            # round machinery even solo; this is the TPU-native win of
-            # keeping the apply on-device.)
+        if (
+            collab.num_peers_at_step <= 1
+            and not self.client_mode
+            and alone_grace
+        ):
+            # alone AT THIS STEP: the group all-reduce is the identity, so
+            # the gradients never leave the device — no device_get, no wire
+            # codec, no matchmaking window. A peer that joins later (or
+            # catches back up) shows up in the tracker at our step and the
+            # next boundary takes the full averaging path. Keying off
+            # num_peers_at_step (not num_peers) matters in fast
+            # collaborations: a partner that fell behind and is mid-resync
+            # CANNOT join this round — waiting a straggler window + burning
+            # averaging timeouts on it stalls the whole collaboration
+            # (round-5 window sweep, docs/fleet.md), and solo-applying is
+            # safe since the lagging peer pulls OUR post-apply state anyway.
+            # (The reference pays hivemind's full round machinery even solo;
+            # this is the TPU-native win of keeping the apply on-device.)
             #
             # The grace period guards the cold-start race: any peer that was
             # alive recently still has an unexpired progress record (so
@@ -310,16 +324,18 @@ class CollaborativeOptimizer:
                 # visible one) keep the full window so a concurrent starter
                 # can still pair with us — the design the solo-grace path
                 # above depends on.
+                # only trainers AT the current step can join this round —
+                # lagging peers are resyncing and must not size the group
                 expected_size=(
-                    collab.num_peers + collab.num_aux
-                    if collab.num_peers >= 2 else None
+                    collab.num_peers_at_step + collab.num_aux
+                    if collab.num_peers_at_step >= 2 else None
                 ),
             )
             contributors = getattr(
                 self.averager, "last_contributors", group_size
             )
             if (averaged is not None and contributors <= 1
-                    and collab.num_peers > 1):
+                    and collab.num_peers_at_step > 1):
                 # nobody else CONTRIBUTED gradients while partner trainers
                 # exist — a singleton group, or a group of just us + aux
                 # donors (zero weight): the partners may be averaging
@@ -331,7 +347,7 @@ class CollaborativeOptimizer:
             if averaged is not None:
                 mean_grads = _named_to_tree(averaged, mean_grads)
                 self._round_failures = 0
-            elif collab.num_peers > 1:
+            elif collab.num_peers_at_step > 1:
                 self._round_failures += 1
                 if self._round_failures <= self.max_round_retries:
                     # better than the reference's local-apply: KEEP the

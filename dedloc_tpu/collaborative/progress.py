@@ -60,6 +60,15 @@ class CollaborationState:
     eta_next_step: float  # seconds
     next_fetch_time: float  # dht time
     num_aux: int = 0  # live aux peers expected to join averaging rounds
+    # trainers whose reported step == optimizer_step: the peers that can
+    # actually JOIN the current round. A peer that fell behind (it missed a
+    # round and is resyncing state) is alive in num_peers but cannot
+    # contribute to this round — group sizing and the solo-round guards key
+    # off THIS count, or a fast collaboration (small target batch) stalls a
+    # full straggler window + averaging timeout per step on partners that
+    # were never coming (observed in the round-5 window sweep,
+    # docs/fleet.md).
+    num_peers_at_step: int = 0
     # start the round this many samples EARLY so matchmaking latency
     # overlaps the tail of accumulation (the reference's batch_size_lead,
     # albert/arguments.py CollaborativeOptimizerArguments)
@@ -160,7 +169,7 @@ class ProgressTracker:
         records = [r for r in by_subkey.values() if not r.aux]
         num_aux = sum(r.aux for r in by_subkey.values())
         max_step, total_samples, total_sps = 0, 0, 0.0
-        num_peers = num_clients = 0
+        num_peers = num_clients = num_at_step = 0
         if records:
             max_step = max(r.step for r in records)
         for r in records:
@@ -169,6 +178,7 @@ class ProgressTracker:
             total_sps += r.samples_per_second
             if r.step == max_step:
                 total_samples += r.samples_accumulated
+                num_at_step += 1
         # throughput below the floor means "not yet measured" (a fresh peer's
         # EMA), NOT a multi-year ETA — treat the ETA as unknown so the refresh
         # period falls back to the default instead of pinning at the maximum
@@ -197,6 +207,7 @@ class ProgressTracker:
             num_peers=num_peers,
             num_clients=num_clients,
             num_aux=num_aux,
+            num_peers_at_step=num_at_step,
             eta_next_step=eta,
             next_fetch_time=self._next_fetch,
             batch_size_lead=self.batch_size_lead,

@@ -300,6 +300,7 @@ def test_round_failure_retries_then_applies_locally():
                 samples_accumulated=10**9,
                 target_batch_size=64,
                 num_peers=2,
+                num_peers_at_step=2,
                 num_clients=0,
                 eta_next_step=0.0,
                 next_fetch_time=get_dht_time() + 60.0,
@@ -623,6 +624,7 @@ def test_step_aux_failed_round_keeps_step_and_retries_same_round():
                 samples_accumulated=10**9,
                 target_batch_size=32,
                 num_peers=2,
+                num_peers_at_step=2,
                 num_clients=0,
                 eta_next_step=0.0,
                 next_fetch_time=get_dht_time() + 60.0,
@@ -692,6 +694,7 @@ def test_trainer_expected_group_size_includes_aux():
                 samples_accumulated=10**9,
                 target_batch_size=64,
                 num_peers=2,
+                num_peers_at_step=2,
                 num_clients=0,
                 num_aux=1,
                 eta_next_step=0.0,
@@ -747,6 +750,7 @@ def test_trainer_plus_aux_group_is_not_averaging_progress():
                 samples_accumulated=10**9,
                 target_batch_size=64,
                 num_peers=2,  # a partner trainer exists...
+                num_peers_at_step=2,  # ...at OUR step
                 num_clients=0,
                 num_aux=1,
                 eta_next_step=0.0,
@@ -780,3 +784,109 @@ def test_member_aux_flag_roundtrip_and_legacy_unpack():
     assert Member.unpack(m.pack()).aux is True
     # legacy 4-field member records (pre-aux peers) default to contributor
     assert Member.unpack([b"p", None, 1.0, b""]).aux is False
+
+
+def test_tracker_counts_peers_at_current_step():
+    """num_peers_at_step: only trainers whose reported step == the global
+    optimizer step can join the current round — a lagging (resyncing) peer
+    is alive in num_peers but excluded from group sizing (round-5 window
+    sweep: sizing groups by num_peers stalls a straggler window + averaging
+    timeout per step on peers that were never coming)."""
+    from dedloc_tpu.collaborative.progress import (
+        LocalProgress,
+        ProgressTracker,
+    )
+    from dedloc_tpu.core.timeutils import get_dht_time
+
+    dht = DHT(start=True, listen_host="127.0.0.1")
+    try:
+        kw = dict(target_batch_size=64, min_refresh_period=0.05,
+                  default_refresh_period=0.1)
+        fast = ProgressTracker(dht, "atstep", peer_subkey=b"fast", **kw)
+        slow = ProgressTracker(dht, "atstep", peer_subkey=b"slow", **kw)
+        fast.report_local_progress(LocalProgress(
+            step=20, samples_accumulated=48, samples_per_second=100.0,
+            time=get_dht_time(),
+        ))
+        slow.report_local_progress(LocalProgress(
+            step=13, samples_accumulated=1, samples_per_second=0.03,
+            time=get_dht_time(), client_mode=True,
+        ))
+        deadline = time.time() + 10
+        collab = fast.fetch_collaboration_state(force=True)
+        while collab.num_peers < 2 and time.time() < deadline:
+            time.sleep(0.1)
+            collab = fast.fetch_collaboration_state(force=True)
+        assert collab.num_peers == 2, collab
+        assert collab.optimizer_step == 20
+        assert collab.num_peers_at_step == 1, collab
+
+        # the slow peer catches up -> it counts again
+        slow.report_local_progress(LocalProgress(
+            step=20, samples_accumulated=1, samples_per_second=0.03,
+            time=get_dht_time(), client_mode=True,
+        ))
+        deadline = time.time() + 10
+        collab = fast.fetch_collaboration_state(force=True)
+        while collab.num_peers_at_step < 2 and time.time() < deadline:
+            time.sleep(0.1)
+            collab = fast.fetch_collaboration_state(force=True)
+        assert collab.num_peers_at_step == 2, collab
+    finally:
+        dht.shutdown()
+
+
+def test_lagging_partner_does_not_stall_solo_rounds():
+    """A visible-but-behind partner must NOT push the leader onto the
+    networked round path (straggler window + retries): with every other
+    trainer lagging, the optimizer takes the on-device solo apply and
+    advances immediately; the laggard resyncs from the leader's state."""
+    from dedloc_tpu.collaborative.progress import CollaborationState
+    from dedloc_tpu.core.timeutils import get_dht_time
+
+    dht = DHT(start=True, listen_host="127.0.0.1")
+    tx = lamb(0.05, weight_decay=0.0)
+    opt = CollaborativeOptimizer(tx, dht, "lagtoy", **_opt_kwargs())
+    try:
+        params = {"w": jnp.array([[0.5], [0.5]])}
+        state = TrainState.create(params, tx)
+        acc_fn = make_accumulate_step(_toy_loss)
+        batch = _make_problem(0)
+        grad_acc = zeros_like_grads(params)
+        n_acc = jnp.zeros([], jnp.int32)
+        grad_acc, n_acc, _ = acc_fn(
+            state.params, grad_acc, n_acc, batch, jax.random.PRNGKey(0)
+        )
+
+        def fake_collab(force=False):
+            return CollaborationState(
+                optimizer_step=opt.local_step,
+                samples_accumulated=10**9,
+                target_batch_size=64,
+                num_peers=2,       # a partner exists...
+                num_peers_at_step=1,  # ...but it fell behind (resyncing)
+                num_clients=1,
+                eta_next_step=0.0,
+                next_fetch_time=get_dht_time() + 60.0,
+            )
+
+        opt.tracker.fetch_collaboration_state = fake_collab
+        opt._created_at = get_dht_time() - 10 * opt.tracker.metadata_expiration
+
+        def must_not_be_called(*a, **k):
+            raise AssertionError(
+                "networked averaging path taken for a round no partner "
+                "could join"
+            )
+
+        opt.averager.step = must_not_be_called
+        before = opt.local_step
+        state, grad_acc, n_acc, stepped = opt.step(
+            state, grad_acc, n_acc, samples=64
+        )
+        assert stepped and opt.local_step == before + 1, (
+            "solo apply must advance the step immediately"
+        )
+    finally:
+        opt.shutdown()
+        dht.shutdown()
