@@ -170,6 +170,7 @@ class DecentralizedAverager:
                                 ep, self.peer_id
                             )
                             self._registered_relays.add(ep)
+                            logger.info(f"registered with relay {ep}")
                             if self.endpoint is None:
                                 self.endpoint = vep  # primary = first live
                         except Exception as e:  # noqa: BLE001

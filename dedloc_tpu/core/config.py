@@ -169,6 +169,10 @@ class AveragerArguments:
     metadata_expiration: float = 30.0
     compression: str = "float16"  # none | float16 | uint8
     bandwidth: float = 1000.0  # advertised Mbps, for weighted partitioning
+    # fixed port for the averager's own RPC server (0 = ephemeral). A
+    # listening averager doubles as a circuit relay, so give PUBLIC peers a
+    # fixed port here and point client-mode volunteers' --dht.relay at it.
+    listen_port: int = 0
 
 
 @dataclass
@@ -223,6 +227,26 @@ class TrainingArguments:
     # with data/seq axes and zero_sharding (ZeRO then shards only the
     # moments TP left replicated).
     mesh_model_devices: int = 1
+    # pipeline parallelism: factor of mesh_devices assigned to a "pipe" mesh
+    # axis — ALBERT's shared block staged across it (GPipe microbatch
+    # schedule under shard_map, parallel/pipeline.py). Composes with the
+    # data axis; "seq"/"model" axes need collectives inside the stage and
+    # are rejected. Checkpoints/grad schemas match the non-pipelined model.
+    mesh_pipe_devices: int = 1
+    # microbatches per boundary on the pipe (0 = 2 x stages); bubble
+    # fraction = (stages-1)/(microbatches+stages-1)
+    pipe_microbatches: int = 0
+    # expert parallelism: factor of mesh_devices assigned to an "expert"
+    # mesh axis — the MoE FFN's experts shard over it (requires
+    # moe_experts % mesh_expert_devices == 0); the Switch dispatch einsums
+    # lower to XLA all-to-alls (parallel/moe.py)
+    mesh_expert_devices: int = 1
+    # >0: replace the dense FFN with a Switch-routed mixture of this many
+    # experts (shared across ALBERT's layer iterations). The load-balancing
+    # aux loss is added at moe_aux_weight.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
     # ZeRO-1: shard optimizer moments over the slice mesh's data axis
     # (state memory / n_devices; params+grads stay replicated for the
     # cross-slice averager). Requires mesh_devices > 1.

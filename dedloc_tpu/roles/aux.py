@@ -82,6 +82,7 @@ def run_aux(
         target_group_size=args.averager.target_group_size,
         averaging_expiration=args.averager.averaging_expiration,
         averaging_timeout=args.averager.averaging_timeout,
+        listen_port=args.averager.listen_port,
         auxiliary=True,
         advertised_host=args.dht.advertised_host or None,
         allow_state_sharing=False,

@@ -126,6 +126,7 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
         statistics_expiration=args.optimizer.statistics_expiration,
         client_mode=args.dht.client_mode,
         relay=args.dht.relay or None,
+        listen_port=args.averager.listen_port,
         advertised_host=args.dht.advertised_host or None,
         mesh=mesh,
         post_apply=make_prototype_post_apply(),

@@ -63,17 +63,43 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
 
         sp = max(1, args.training.mesh_seq_devices)
         tp = max(1, args.training.mesh_model_devices)
-        if args.training.mesh_devices % (sp * tp):
+        pp = max(1, args.training.mesh_pipe_devices)
+        ep = max(1, args.training.mesh_expert_devices)
+        if args.training.mesh_devices % (sp * tp * pp * ep):
             raise ValueError(
-                f"mesh_seq_devices ({sp}) x mesh_model_devices ({tp}) must "
-                f"divide mesh_devices ({args.training.mesh_devices})"
+                f"mesh_seq_devices ({sp}) x mesh_model_devices ({tp}) x "
+                f"mesh_pipe_devices ({pp}) x mesh_expert_devices ({ep}) "
+                f"must divide mesh_devices ({args.training.mesh_devices})"
             )
-        dp = args.training.mesh_devices // (sp * tp)
+        if pp > 1 and (sp > 1 or tp > 1):
+            # the pipeline stage body runs inside its own shard_map; ring
+            # attention ("seq") and the TP layouts ("model") place their
+            # collectives via GSPMD annotations, which don't apply there
+            raise ValueError(
+                "mesh_pipe_devices composes with the data axis only; "
+                "seq/model axes need collectives inside the pipeline stage"
+            )
+        if ep > 1 and not args.training.moe_experts:
+            raise ValueError(
+                "mesh_expert_devices > 1 needs --training.moe_experts > 0"
+            )
+        if args.training.moe_experts and (
+            args.training.moe_experts % ep
+        ):
+            raise ValueError(
+                f"moe_experts ({args.training.moe_experts}) must divide "
+                f"evenly over mesh_expert_devices ({ep})"
+            )
+        dp = args.training.mesh_devices // (sp * tp * pp * ep)
         names, dims = ["data"], [dp]
         if tp > 1:
             names.append("model"); dims.append(tp)
         if sp > 1:
             names.append("seq"); dims.append(sp)
+        if pp > 1:
+            names.append("pipe"); dims.append(pp)
+        if ep > 1:
+            names.append("expert"); dims.append(ep)
         mesh = make_mesh(
             args.training.mesh_devices,
             axis_names=tuple(names),
@@ -81,9 +107,14 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
             device_offset=args.training.mesh_device_offset,
         )
         logger.info(f"slice mesh: {mesh.shape}")
-    elif args.training.mesh_seq_devices > 1 or args.training.mesh_model_devices > 1:
+    elif (
+        args.training.mesh_seq_devices > 1
+        or args.training.mesh_model_devices > 1
+        or args.training.mesh_pipe_devices > 1
+        or args.training.mesh_expert_devices > 1
+    ):
         raise ValueError(
-            "mesh_seq_devices/mesh_model_devices > 1 require mesh_devices > 1"
+            "mesh_seq/model/pipe/expert_devices > 1 require mesh_devices > 1"
         )
     if args.training.attention_impl == "ring" and (
         mesh is None or "seq" not in mesh.axis_names
@@ -100,6 +131,16 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         args.training.attention_impl,
         args.training.vocab_size,
         ring_mesh=mesh if args.training.attention_impl == "ring" else None,
+        pipe_mesh=(
+            mesh if mesh is not None and "pipe" in mesh.axis_names else None
+        ),
+        pipe_microbatches=args.training.pipe_microbatches,
+        moe_experts=args.training.moe_experts,
+        moe_mesh=(
+            mesh if mesh is not None and "expert" in mesh.axis_names else None
+        ),
+        moe_capacity_factor=args.training.moe_capacity_factor,
+        moe_aux_weight=args.training.moe_aux_weight,
     )
     tx = build_optimizer(args)
     dht, public_key = build_dht(args)
@@ -142,29 +183,43 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
             "mesh; set --training.mesh_devices > 1"
         )
     # tensor parallelism: Megatron-style param layout over the "model" axis
-    # (parallel/sharding.py rules); moments follow their params' layout
+    # (parallel/sharding.py rules); moments follow their params' layout.
+    # EP composes by rule concatenation: the expert-stacked MoE leaves
+    # shard over "expert", everything TP doesn't claim stays replicated.
     param_sharding = None
-    if mesh is not None and "model" in mesh.axis_names:
+    shard_rules = None
+    if mesh is not None and (
+        "model" in mesh.axis_names or "expert" in mesh.axis_names
+    ):
         from jax.sharding import NamedSharding
-        from dedloc_tpu.parallel.sharding import partition_specs
+        from dedloc_tpu.parallel.sharding import (
+            ALBERT_EP_RULES,
+            ALBERT_TP_RULES,
+            partition_specs,
+        )
 
+        shard_rules = tuple(
+            (ALBERT_TP_RULES if "model" in mesh.axis_names else ())
+        ) + tuple(
+            (ALBERT_EP_RULES if "expert" in mesh.axis_names else ())
+        )
         param_sharding = jax.tree.map(
-            lambda s: NamedSharding(mesh, s), partition_specs(state.params)
+            lambda s: NamedSharding(mesh, s),
+            partition_specs(state.params, shard_rules),
         )
     opt_sharding = None
     if mesh is not None and (args.training.zero_sharding
                              or param_sharding is not None):
         # ZeRO-1: LAMB moments shard over the slice's data axis; GSPMD
         # inserts the gathers the elementwise update needs (parallel/zero.py).
-        # With TP, moments of TP-sharded params follow the TP layout and
+        # With TP/EP, moments of sharded params follow the param layout and
         # ZeRO (when enabled) shards only the rest.
-        from dedloc_tpu.parallel.sharding import ALBERT_TP_RULES
         from dedloc_tpu.parallel.zero import opt_state_shardings
 
         opt_sharding = opt_state_shardings(
             state.opt_state, mesh,
             axis="data" if args.training.zero_sharding else None,
-            tp_rules=ALBERT_TP_RULES if param_sharding is not None else None,
+            tp_rules=shard_rules,
         )
 
     opt = CollaborativeOptimizer(
@@ -191,6 +246,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         performance_ema_alpha=args.averager.performance_ema_alpha,
         client_mode=args.dht.client_mode,
         relay=args.dht.relay or None,
+        listen_port=args.averager.listen_port,
         advertised_host=args.dht.advertised_host or None,
         allow_state_sharing=args.optimizer.allow_state_sharing,
         mesh=mesh,

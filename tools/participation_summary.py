@@ -1,0 +1,109 @@
+"""Summarize a hetero fleet run's round participation and cadence.
+
+Eats the per-peer logs a `tools/hetero_converge.sh` run leaves under $RUN
+and prints ONE JSON line:
+
+    python tools/participation_summary.py /root/corpus/r5_probe_w30
+
+- tpu_steps / tpu_steps_per_min: the TPU peer's applied global steps and
+  cadence (from train_log_tpu.jsonl wall clock).
+- group_hist: group sizes of the TPU peer's applied rounds (from its role
+  log "applied (group=G, ...)" lines) — group counts trainers + aux.
+- volN_participation: fraction of the TPU's applied rounds that volunteer N
+  also applied with group>=2 (i.e. it averaged WITH somebody, not a local
+  fallback) — the CPU-volunteer round-participation rate of VERDICT r4 #6.
+- relay/nat evidence: counts of relay registrations, punch upgrades and
+  connection reversals in the volunteer logs (the hardened-transport
+  capabilities of p2p/NAT-traversal.md:86-111 actually firing).
+"""
+
+import json
+import re
+import sys
+from pathlib import Path
+
+APPLIED = re.compile(r"global step (\d+) applied \(group=(\d+)")
+
+
+def applied_rounds(role_log: Path):
+    """[(global_step, group_size)] a peer applied, from its role log."""
+    if not role_log.exists():
+        return []
+    out = []
+    for line in role_log.read_text(errors="replace").splitlines():
+        m = APPLIED.search(line)
+        if m:
+            out.append((int(m.group(1)), int(m.group(2))))
+    return out
+
+
+def count(path: Path, needle: str) -> int:
+    if not path.exists():
+        return 0
+    return path.read_text(errors="replace").count(needle)
+
+
+def main(run_dir: str) -> dict:
+    run = Path(run_dir)
+    tpu = applied_rounds(run / "trainer_tpu.log")
+    hist = {}
+    for _, g in tpu:
+        hist[g] = hist.get(g, 0) + 1
+
+    result = {
+        "run": run.name,
+        "tpu_steps": len(tpu),
+        "group_hist": {str(k): v for k, v in sorted(hist.items())},
+    }
+
+    log = run / "train_log_tpu.jsonl"
+    if log.exists():
+        rows = [json.loads(x) for x in log.read_text().splitlines() if x.strip()]
+        if len(rows) >= 2:
+            span_min = (rows[-1]["wall_s"] - rows[0]["wall_s"]) / 60
+            result["tpu_steps_per_min"] = round((len(rows) - 1) / span_min, 2)
+            result["last_step"] = rows[-1]["step"]
+            result["last_loss"] = round(rows[-1]["loss"], 3)
+            tail = [
+                r for r in rows if r["wall_s"] >= rows[-1]["wall_s"] - 180
+            ]
+            if len(tail) >= 2:
+                tail_min = (tail[-1]["wall_s"] - tail[0]["wall_s"]) / 60
+                result["tpu_steps_per_min_last3min"] = round(
+                    (len(tail) - 1) / tail_min, 2
+                )
+
+    tpu_steps = {s for s, _ in tpu}
+    for vol_log in sorted(run.glob("trainer_vol*.log")):
+        name = vol_log.stem.replace("trainer_", "")
+        vol = applied_rounds(vol_log)
+        joined = {s for s, g in vol if g >= 2}
+        result[f"{name}_participation"] = (
+            round(len(joined & tpu_steps) / len(tpu_steps), 3)
+            if tpu_steps else 0.0
+        )
+        # post-warmup rate: CPU volunteers spend their first minutes
+        # compiling; measure joins only over TPU rounds from the
+        # volunteer's first applied step onward (the steady-state rate
+        # the straggler-window sweep cares about)
+        if vol and tpu_steps:
+            first = vol[0][0]
+            window = {s for s in tpu_steps if s >= first}
+            result[f"{name}_participation_steady"] = (
+                round(len(joined & window) / len(window), 3)
+                if window else 0.0
+            )
+        result[f"{name}_relay_registrations"] = count(
+            vol_log, "registered with relay"
+        )
+        result[f"{name}_nat_punches"] = count(vol_log, "nat: punched direct")
+        result[f"{name}_nat_reversals"] = count(
+            vol_log, "(connection reversal)"
+        )
+    return result
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        sys.exit(f"usage: {sys.argv[0]} RUN_DIR")
+    print(json.dumps(main(sys.argv[1])))
