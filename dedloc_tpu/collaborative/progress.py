@@ -60,14 +60,18 @@ class CollaborationState:
     eta_next_step: float  # seconds
     next_fetch_time: float  # dht time
     num_aux: int = 0  # live aux peers expected to join averaging rounds
-    # trainers whose reported step == optimizer_step: the peers that can
-    # actually JOIN the current round. A peer that fell behind (it missed a
-    # round and is resyncing state) is alive in num_peers but cannot
-    # contribute to this round — group sizing and the solo-round guards key
-    # off THIS count, or a fast collaboration (small target batch) stalls a
-    # full straggler window + averaging timeout per step on partners that
-    # were never coming (observed in the round-5 window sweep,
-    # docs/fleet.md).
+    # trainers whose reported step is optimizer_step OR one behind: the
+    # peers that can actually JOIN the current round. One-behind counts
+    # because a peer that just applied the previous round reports its new
+    # step only at its next boundary — progress records are seconds stale,
+    # and a leader that solo-applies on that staleness strands its partners
+    # mid-matchmaking (observed in the round-5 window sweep: first joint
+    # round fine, then the fast peer raced ahead for good, docs/fleet.md).
+    # A peer MORE than one behind fell out (it is resyncing state) and
+    # cannot contribute — group sizing and the solo-round guards key off
+    # THIS count, or a fast collaboration (small target batch) stalls a
+    # straggler window + averaging timeout per step on partners that were
+    # never coming.
     num_peers_at_step: int = 0
     # start the round this many samples EARLY so matchmaking latency
     # overlaps the tail of accumulation (the reference's batch_size_lead,
@@ -178,6 +182,7 @@ class ProgressTracker:
             total_sps += r.samples_per_second
             if r.step == max_step:
                 total_samples += r.samples_accumulated
+            if r.step >= max_step - 1:
                 num_at_step += 1
         # throughput below the floor means "not yet measured" (a fresh peer's
         # EMA), NOT a multi-year ETA — treat the ETA as unknown so the refresh

@@ -644,7 +644,8 @@ def test_gated_join_rejects_impersonated_member_id():
             # seed a led round: joins for rounds the peer never led are
             # rejected before any envelope cryptography runs
             mm._leading["r1"] = (
-                {}, {}, asyncio.Event(), asyncio.Event(), 256, "nonce1"
+                {}, {}, asyncio.Event(), asyncio.Event(), 256, "nonce1",
+                [False],
             )
             # mallory holds a VALID token but claims the leader's peer_id
             token = await mallory_auth.refresh_token_if_needed()
@@ -1682,3 +1683,98 @@ def test_relay_failover_client_keeps_averaging(rng):
     finally:
         client.shutdown(); public.shutdown()
         d1.shutdown(); root.shutdown()
+
+
+def test_concurrent_leaders_with_followers_dissolve_into_one_group(rng):
+    """Two peers declare leadership for the same round near-simultaneously
+    (each missed the other's DHT entry) and each picks up a follower.
+    Before round 5 the two partial groups deadlocked until the straggler
+    window expired (observed in the w120 probe: TPU+aux vs vol1+vol2 for
+    the same round id); now the worse-ranked leader DISSOLVES — its pending
+    joiners fail fast and everyone re-joins the better leader — so one full
+    group forms in seconds even under a long window."""
+    from dedloc_tpu.averaging import DecentralizedAverager
+    from dedloc_tpu.dht import DHT
+
+    N = 4
+    WINDOW = 25.0
+    root = DHT(start=True, listen_host="127.0.0.1")
+    dhts = [root] + [
+        DHT(start=True, listen_host="127.0.0.1",
+            initial_peers=[root.get_visible_address()])
+        for _ in range(N - 1)
+    ]
+    avgs = [
+        DecentralizedAverager(
+            d, "dissolve", averaging_expiration=WINDOW,
+            averaging_timeout=60.0, compression="none",
+            listen_host="127.0.0.1",
+        )
+        for d in dhts
+    ]
+    # force the race: peers 0 and 1 see NO live leaders on their first
+    # lookup, so both decide to lead; peers 2 and 3 (the followers) see the
+    # truth and attach to whichever leader ranks best in their view
+    for a in avgs[:2]:
+        mm = a.matchmaking
+        orig = mm._live_leaders
+        state = {"first": True}
+
+        async def blind_once(round_id, _orig=orig, _state=state):
+            if _state["first"]:
+                _state["first"] = False
+                return []
+            return await _orig(round_id)
+
+        mm._live_leaders = blind_once
+
+    # force the SPLIT: follower 3 joins the WORST-ranked leader (reversed
+    # view), so one leader certainly ends up with a follower it must kick
+    # when it dissolves — the exact deadlock shape from the probe
+    mm3 = avgs[3].matchmaking
+    orig3 = mm3._live_leaders
+
+    async def reversed_view(round_id):
+        leaders = await orig3(round_id)
+        return list(reversed(leaders))
+
+    mm3._live_leaders = reversed_view
+
+    results = {}
+
+    def peer(i):
+        vec = np.zeros((N,), np.float32)
+        vec[i] = 1.0
+        results[i] = avgs[i].step({"v": vec}, weight=1.0, round_id="r0",
+                                  expected_size=N)
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=peer, args=(i,), daemon=True)
+        for i in range(N)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        wall = time.perf_counter() - t0
+        sizes = sorted(g for (_, g) in results.values())
+        assert sizes == [N] * N, (
+            f"expected one full group of {N}, got group sizes {sizes} "
+            f"(a partial-group deadlock)"
+        )
+        for i in range(N):
+            np.testing.assert_allclose(
+                results[i][0]["v"], np.full((N,), 1.0 / N, np.float32),
+                atol=1e-6,
+            )
+        # the whole point: assembly must not idle out the window
+        assert wall < WINDOW, (
+            f"group formed only after the straggler window ({wall:.1f}s)"
+        )
+    finally:
+        for a in avgs:
+            a.shutdown()
+        for d in dhts:
+            d.shutdown()

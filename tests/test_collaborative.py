@@ -821,7 +821,20 @@ def test_tracker_counts_peers_at_current_step():
         assert collab.optimizer_step == 20
         assert collab.num_peers_at_step == 1, collab
 
-        # the slow peer catches up -> it counts again
+        # one-behind counts as current: a partner that just applied the
+        # previous round reports its new step only at its next boundary
+        slow.report_local_progress(LocalProgress(
+            step=19, samples_accumulated=1, samples_per_second=0.03,
+            time=get_dht_time(), client_mode=True,
+        ))
+        deadline = time.time() + 10
+        collab = fast.fetch_collaboration_state(force=True)
+        while collab.num_peers_at_step < 2 and time.time() < deadline:
+            time.sleep(0.1)
+            collab = fast.fetch_collaboration_state(force=True)
+        assert collab.num_peers_at_step == 2, collab
+
+        # the slow peer catches up fully -> still counted
         slow.report_local_progress(LocalProgress(
             step=20, samples_accumulated=1, samples_per_second=0.03,
             time=get_dht_time(), client_mode=True,
@@ -864,7 +877,9 @@ def test_lagging_partner_does_not_stall_solo_rounds():
                 samples_accumulated=10**9,
                 target_batch_size=64,
                 num_peers=2,       # a partner exists...
-                num_peers_at_step=1,  # ...but it fell behind (resyncing)
+                num_peers_at_step=1,  # ...but it fell >1 step behind
+                # (resyncing) — one-behind partners count as current and
+                # take the networked path instead
                 num_clients=1,
                 eta_next_step=0.0,
                 next_fetch_time=get_dht_time() + 60.0,
