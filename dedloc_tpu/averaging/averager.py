@@ -337,6 +337,7 @@ class DecentralizedAverager:
         round_id: str,
         return_future: bool = False,
         expected_size: Optional[int] = None,
+        window: Optional[float] = None,
     ):
         """Average ``tree`` with whatever group forms for ``round_id``.
 
@@ -347,10 +348,17 @@ class DecentralizedAverager:
         ``expected_size``: the collaboration's live peer count, if known —
         lets the leader assemble the moment the group is full instead of
         idling out the straggler window (matchmaking.form_group).
+
+        ``window``: per-round override of ``averaging_expiration`` — the
+        collaborative optimizer shortens the leader wait when the partners
+        it is waiting on are only NEAR the current step (they may never
+        arrive; see CollaborationState.num_peers_near_step).
         """
 
         def _run(node):
-            return self._step_async(tree, weight, round_id, expected_size)
+            return self._step_async(
+                tree, weight, round_id, expected_size, window
+            )
 
         fut = self.dht.run_coroutine(_run, return_future=True)
         return fut if return_future else fut.result()
@@ -358,11 +366,12 @@ class DecentralizedAverager:
     async def _step_async(
         self, tree: Dict[str, np.ndarray], weight: float, round_id: str,
         expected_size: Optional[int] = None,
+        window: Optional[float] = None,
     ) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
         try:
             group = await self.matchmaking.form_group(
                 round_id, schema=schema_fingerprint(tree),
-                expected_size=expected_size,
+                expected_size=expected_size, window=window,
             )
         except MatchmakingFailed as e:
             logger.debug(f"matchmaking failed for {round_id}: {e}")

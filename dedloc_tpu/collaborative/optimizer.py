@@ -276,7 +276,7 @@ class CollaborativeOptimizer:
             >= self.tracker.metadata_expiration
         )
         if (
-            collab.num_peers_at_step <= 1
+            collab.num_peers_near_step <= 1
             and not self.client_mode
             and alone_grace
         ):
@@ -309,6 +309,18 @@ class CollaborativeOptimizer:
         named = _tree_to_named(mean_grads)  # device_get of the full grad tree
         self.seam_ms["grads_device_get"] = (time.perf_counter() - t0) * 1e3
 
+        # partners CERTAIN to be joinable (reported exactly our step) get
+        # the full straggler window; partners merely NEAR (one behind —
+        # usually a just-applied record that hasn't refreshed, possibly a
+        # peer stuck retrying the previous round) get a short grace only:
+        # a genuinely-arriving partner shows up within ~2 refresh periods,
+        # and a stuck one must not hold the collaboration hostage for a
+        # window + averaging timeout per step (round-5 sweep, docs/fleet.md)
+        partners_certain = collab.num_peers_at_step > 1
+        near_grace = min(
+            self.averager.averaging_expiration,
+            max(2.0, 2.0 * self.tracker.default_refresh_period),
+        )
         self.performance_ema.pause()
         try:
             averaged, group_size = self.averager.step(
@@ -323,31 +335,33 @@ class CollaborativeOptimizer:
                 # start (num_peers <= 1: our own record may be the only
                 # visible one) keep the full window so a concurrent starter
                 # can still pair with us — the design the solo-grace path
-                # above depends on.
-                # only trainers AT the current step can join this round —
-                # lagging peers are resyncing and must not size the group
+                # above depends on. Only near-step trainers are counted —
+                # lagging peers are resyncing and must not size the group.
                 expected_size=(
-                    collab.num_peers_at_step + collab.num_aux
-                    if collab.num_peers_at_step >= 2 else None
+                    collab.num_peers_near_step + collab.num_aux
+                    if collab.num_peers_near_step >= 2 else None
                 ),
+                window=None if partners_certain else near_grace,
             )
             contributors = getattr(
                 self.averager, "last_contributors", group_size
             )
             if (averaged is not None and contributors <= 1
-                    and collab.num_peers_at_step > 1):
+                    and partners_certain):
                 # nobody else CONTRIBUTED gradients while partner trainers
-                # exist — a singleton group, or a group of just us + aux
-                # donors (zero weight): the partners may be averaging
-                # without us this round, and applying our local grads now
-                # would diverge the replicas. Treat it as a failed round —
-                # the retry keeps the grads; repeated misses fall back to
-                # local-apply + resync below.
+                # exist AT OUR STEP — a singleton group, or a group of just
+                # us + aux donors (zero weight): the partners may be
+                # averaging without us this round, and applying our local
+                # grads now would diverge the replicas. Treat it as a failed
+                # round — the retry keeps the grads; repeated misses fall
+                # back to local-apply + resync below. (Near-step-only rounds
+                # skip this: a peer one behind is on the PREVIOUS round id,
+                # so nobody can be averaging round N without us.)
                 averaged = None
             if averaged is not None:
                 mean_grads = _named_to_tree(averaged, mean_grads)
                 self._round_failures = 0
-            elif collab.num_peers_at_step > 1:
+            elif partners_certain:
                 self._round_failures += 1
                 if self._round_failures <= self.max_round_retries:
                     # better than the reference's local-apply: KEEP the

@@ -60,19 +60,21 @@ class CollaborationState:
     eta_next_step: float  # seconds
     next_fetch_time: float  # dht time
     num_aux: int = 0  # live aux peers expected to join averaging rounds
-    # trainers whose reported step is optimizer_step OR one behind: the
-    # peers that can actually JOIN the current round. One-behind counts
-    # because a peer that just applied the previous round reports its new
-    # step only at its next boundary — progress records are seconds stale,
-    # and a leader that solo-applies on that staleness strands its partners
-    # mid-matchmaking (observed in the round-5 window sweep: first joint
-    # round fine, then the fast peer raced ahead for good, docs/fleet.md).
-    # A peer MORE than one behind fell out (it is resyncing state) and
-    # cannot contribute — group sizing and the solo-round guards key off
-    # THIS count, or a fast collaboration (small target batch) stalls a
-    # straggler window + averaging timeout per step on partners that were
-    # never coming.
+    # trainers whose reported step == optimizer_step: the peers that can
+    # certainly JOIN the current round — these get the full straggler
+    # window. A peer more than one behind fell out (it is resyncing state)
+    # and cannot contribute; sizing groups on it stalls a full window +
+    # averaging timeout per step (round-5 window sweep, docs/fleet.md).
     num_peers_at_step: int = 0
+    # ...plus peers exactly ONE step behind: usually a partner that just
+    # applied the previous round and reports its new step only at its next
+    # boundary (progress records are seconds stale) — but possibly one
+    # stuck retrying the PREVIOUS round that will never arrive. The leader
+    # therefore gives near-step-only rounds a SHORT grace, not the full
+    # window: a genuinely-arriving partner shows up within a couple of
+    # refresh periods, a stuck one must not hold the collaboration hostage
+    # (both failure shapes observed in the round-5 sweep).
+    num_peers_near_step: int = 0
     # start the round this many samples EARLY so matchmaking latency
     # overlaps the tail of accumulation (the reference's batch_size_lead,
     # albert/arguments.py CollaborativeOptimizerArguments)
@@ -173,7 +175,7 @@ class ProgressTracker:
         records = [r for r in by_subkey.values() if not r.aux]
         num_aux = sum(r.aux for r in by_subkey.values())
         max_step, total_samples, total_sps = 0, 0, 0.0
-        num_peers = num_clients = num_at_step = 0
+        num_peers = num_clients = num_at_step = num_near = 0
         if records:
             max_step = max(r.step for r in records)
         for r in records:
@@ -182,8 +184,9 @@ class ProgressTracker:
             total_sps += r.samples_per_second
             if r.step == max_step:
                 total_samples += r.samples_accumulated
-            if r.step >= max_step - 1:
                 num_at_step += 1
+            if r.step >= max_step - 1:
+                num_near += 1
         # throughput below the floor means "not yet measured" (a fresh peer's
         # EMA), NOT a multi-year ETA — treat the ETA as unknown so the refresh
         # period falls back to the default instead of pinning at the maximum
@@ -213,6 +216,7 @@ class ProgressTracker:
             num_clients=num_clients,
             num_aux=num_aux,
             num_peers_at_step=num_at_step,
+            num_peers_near_step=num_near,
             eta_next_step=eta,
             next_fetch_time=self._next_fetch,
             batch_size_lead=self.batch_size_lead,

@@ -23,6 +23,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
+from jax.sharding import PartitionSpec as P
 
 Dtype = Any
 
@@ -69,6 +70,26 @@ class AlbertConfig:
     # trainer when --training.mesh_seq_devices > 1)
     ring_mesh: Any = None
     ring_axis: str = "seq"
+    # pipeline parallelism (--training.mesh_pipe_devices): the mesh whose
+    # ``pipe_axis`` the encoder's layer iterations are staged over — ALBERT's
+    # shared block applied num_hidden_layers/n_stages times per stage, GPipe
+    # microbatch schedule under shard_map (parallel/pipeline.py). The param
+    # tree is IDENTICAL to the scanned path (encoder/layer/block/...), so
+    # checkpoints and collaborative gradient schemas interchange freely
+    # between pipelined and non-pipelined peers.
+    pipe_mesh: Any = None
+    pipe_axis: str = "pipe"
+    pipe_microbatches: int = 0  # 0 = 2 x n_stages (bubble = (S-1)/(M+S-1))
+    # Switch-MoE FFN variant (--training.moe_experts, parallel/moe.py): the
+    # dense gelu FFN becomes a top-1-routed expert FFN; experts shard over
+    # ``moe_axis`` when ``moe_mesh`` is set (--training.mesh_expert_devices),
+    # the dispatch einsums lowering to XLA all-to-alls. The Switch
+    # load-balancing aux loss is sowed into the "losses" collection.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    moe_mesh: Any = None
+    moe_axis: str = "expert"
 
     @staticmethod
     def named(model_size: str):
@@ -231,7 +252,11 @@ class AlbertSelfAttention(nn.Module):
 
 
 class AlbertLayer(nn.Module):
-    """One shared transformer block (attention + gelu FFN, post-LN)."""
+    """One shared transformer block (attention + FFN, post-LN).
+
+    Returns ``(hidden, aux_loss)`` — aux_loss is the Switch load-balancing
+    term when ``cfg.moe_experts`` routes the FFN through experts, else 0.
+    """
 
     cfg: AlbertConfig
     deterministic: bool = True
@@ -243,20 +268,68 @@ class AlbertLayer(nn.Module):
         hidden = AlbertSelfAttention(cfg, deterministic, name="attention")(
             hidden, attn_bias
         )
-        # named for the fused_ln remat policy: the FFN up-projection is the
-        # one matmul output the backward cannot cheaply recompute (gelu's
-        # input); everything downstream is covered by saved Pallas outputs
-        ffn = checkpoint_name(
-            _dense(cfg.intermediate_size, cfg, "ffn")(hidden), "ffn_up"
-        )
-        # also named so fused_ln_gelu can save the activation output and
-        # skip the gelu forward replay in the remat backward (naming is
-        # free for policies that don't reference it)
-        ffn = checkpoint_name(nn.gelu(ffn, approximate=True), "ffn_gelu")
-        ffn = _dense(cfg.hidden_size, cfg, "ffn_output")(ffn)
+        aux = jnp.zeros([], jnp.float32)
+        if cfg.moe_experts > 0:
+            ffn, aux = self._moe_ffn(hidden)
+        else:
+            # named for the fused_ln remat policy: the FFN up-projection is
+            # the one matmul output the backward cannot cheaply recompute
+            # (gelu's input); everything downstream is covered by saved
+            # Pallas outputs
+            ffn = checkpoint_name(
+                _dense(cfg.intermediate_size, cfg, "ffn")(hidden), "ffn_up"
+            )
+            # also named so fused_ln_gelu can save the activation output and
+            # skip the gelu forward replay in the remat backward (naming is
+            # free for policies that don't reference it)
+            ffn = checkpoint_name(nn.gelu(ffn, approximate=True), "ffn_gelu")
+            ffn = _dense(cfg.hidden_size, cfg, "ffn_output")(ffn)
         if cfg.hidden_dropout_prob > 0.0 and not deterministic:
             ffn = nn.Dropout(cfg.hidden_dropout_prob)(ffn, deterministic=deterministic)
-        return AddLayerNorm(cfg, name="layernorm")(ffn, hidden)
+        return AddLayerNorm(cfg, name="layernorm")(ffn, hidden), aux
+
+    def _moe_ffn(self, hidden):
+        """Switch-routed FFN (parallel/moe.py): one expert set shared across
+        the layer iterations — ALBERT's cross-layer sharing extended to the
+        experts. Router/expert weights live in this layer's param tree, so
+        checkpoints and the collaborative gradient schema carry them like
+        any other leaf."""
+        from dedloc_tpu.parallel.moe import MoEConfig, moe_ffn
+
+        cfg = self.cfg
+        B, S, H = hidden.shape
+        mcfg = MoEConfig(
+            hidden_size=cfg.hidden_size,
+            ffn_size=cfg.intermediate_size,
+            num_experts=cfg.moe_experts,
+            capacity_factor=cfg.moe_capacity_factor,
+            dtype=cfg.dtype,
+        )
+        init = nn.initializers.normal(cfg.initializer_range)
+        params = {
+            "router": self.param(
+                "moe_router", init, (H, cfg.moe_experts), jnp.float32
+            ),
+            "wi": self.param(
+                "moe_wi", init,
+                (cfg.moe_experts, H, cfg.intermediate_size), jnp.float32,
+            ),
+            "wo": self.param(
+                "moe_wo", init,
+                (cfg.moe_experts, cfg.intermediate_size, H), jnp.float32,
+            ),
+        }
+        # bf16 expert compute like the dense FFN; router math is fp32 inside
+        params = {
+            "router": params["router"],
+            "wi": params["wi"].astype(cfg.dtype),
+            "wo": params["wo"].astype(cfg.dtype),
+        }
+        y, aux = moe_ffn(
+            params, hidden.reshape(B * S, H), mcfg,
+            mesh=cfg.moe_mesh, axis=cfg.moe_axis,
+        )
+        return y.reshape(B, S, H).astype(cfg.dtype), aux
 
 
 #: The only policy names that engage the fused add+LN Pallas kernel; a
@@ -280,8 +353,66 @@ def _pallas_outputs_saveable(prim, *_, **__) -> bool:
     return getattr(prim, "name", "") == "pallas_call"
 
 
+def remat_policy_object(name: str):
+    """Resolve a remat-policy NAME to the jax.checkpoint policy object — the
+    one table both the scanned encoder and the pipeline-parallel stage wrap
+    their layer body with (so --training.remat_policy means the same thing
+    on every parallelism path). Raises on unknown names."""
+    table = {
+        "nothing": jax.checkpoint_policies.nothing_saveable,
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_no_batch": (
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        ),
+        # dots_no_batch + flash-attention outputs (out, lse): the
+        # custom-VJP backward then runs straight from saved residuals
+        # instead of re-running the forward kernel during remat
+        # (~30 MB/layer extra HBM at B=32, measured step win on v5e)
+        "dots_no_batch_attn": (
+            jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                _pallas_outputs_saveable,
+            )
+        ),
+        # fused-LN recipe (pairs with cfg.fused_ln): save ONLY the
+        # named matmul outputs (q/k/v in flash layout, FFN up) plus
+        # every Pallas kernel's outputs — flash (out, lse) and the
+        # fused add+LN's (y, x̂, rstd). The backward then replays no
+        # elementwise chain; dropping the two out-projection dot
+        # saves pays for the x̂ residuals, so HBM is ~neutral vs
+        # dots_no_batch_attn.
+        "fused_ln": (
+            jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_qkv", "ffn_up"
+                ),
+                _pallas_outputs_saveable,
+            )
+        ),
+        # fused_ln + the gelu output: the backward's one remaining
+        # forward replay (gelu of the FFN up-projection) runs from a
+        # saved residual instead — costs [B,S,intermediate] bf16 per
+        # layer iteration of extra HBM (ffn_up stays saved: gelu's
+        # VJP still needs its primal input)
+        "fused_ln_gelu": (
+            jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_qkv", "ffn_up", "ffn_gelu"
+                ),
+                _pallas_outputs_saveable,
+            )
+        ),
+    }
+    if name not in table:
+        raise ValueError(
+            f"unknown remat_policy {name!r}; expected one of {sorted(table)}"
+        )
+    return table[name]
+
+
 class _ScannedAlbertLayer(nn.Module):
-    """scan body: carry = hidden states; attn_bias broadcast; no per-step out."""
+    """scan body: carry = hidden states; attn_bias broadcast; per-step out =
+    the layer's aux (MoE load-balance) loss."""
 
     cfg: AlbertConfig
     deterministic: bool = True
@@ -290,64 +421,19 @@ class _ScannedAlbertLayer(nn.Module):
     def __call__(self, hidden, attn_bias):
         layer_cls = AlbertLayer
         if self.cfg.remat:
-            policy = {
-                "nothing": jax.checkpoint_policies.nothing_saveable,
-                "dots": jax.checkpoint_policies.checkpoint_dots,
-                "dots_no_batch": (
-                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                ),
-                # dots_no_batch + flash-attention outputs (out, lse): the
-                # custom-VJP backward then runs straight from saved residuals
-                # instead of re-running the forward kernel during remat
-                # (~30 MB/layer extra HBM at B=32, measured step win on v5e)
-                "dots_no_batch_attn": (
-                    jax.checkpoint_policies.save_from_both_policies(
-                        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                        _pallas_outputs_saveable,
-                    )
-                ),
-                # fused-LN recipe (pairs with cfg.fused_ln): save ONLY the
-                # named matmul outputs (q/k/v in flash layout, FFN up) plus
-                # every Pallas kernel's outputs — flash (out, lse) and the
-                # fused add+LN's (y, x̂, rstd). The backward then replays no
-                # elementwise chain; dropping the two out-projection dot
-                # saves pays for the x̂ residuals, so HBM is ~neutral vs
-                # dots_no_batch_attn.
-                "fused_ln": (
-                    jax.checkpoint_policies.save_from_both_policies(
-                        jax.checkpoint_policies.save_only_these_names(
-                            "flash_qkv", "ffn_up"
-                        ),
-                        _pallas_outputs_saveable,
-                    )
-                ),
-                # fused_ln + the gelu output: the backward's one remaining
-                # forward replay (gelu of the FFN up-projection) runs from a
-                # saved residual instead — costs [B,S,intermediate] bf16 per
-                # layer iteration of extra HBM (ffn_up stays saved: gelu's
-                # VJP still needs its primal input)
-                "fused_ln_gelu": (
-                    jax.checkpoint_policies.save_from_both_policies(
-                        jax.checkpoint_policies.save_only_these_names(
-                            "flash_qkv", "ffn_up", "ffn_gelu"
-                        ),
-                        _pallas_outputs_saveable,
-                    )
-                ),
-            }
-            if self.cfg.remat_policy not in policy:
-                raise ValueError(
-                    f"unknown remat_policy {self.cfg.remat_policy!r}; "
-                    f"expected one of {sorted(policy)}"
-                )
-            policy = policy[self.cfg.remat_policy]
-            layer_cls = nn.remat(AlbertLayer, policy=policy)
-        out = layer_cls(self.cfg, self.deterministic, name="block")(hidden, attn_bias)
-        return out, ()
+            layer_cls = nn.remat(
+                AlbertLayer, policy=remat_policy_object(self.cfg.remat_policy)
+            )
+        out, aux = layer_cls(self.cfg, self.deterministic, name="block")(
+            hidden, attn_bias
+        )
+        return out, aux
 
 
 class AlbertEncoder(nn.Module):
-    """Shared-parameter layer stack via nn.scan: one layer body in the HLO."""
+    """Shared-parameter layer stack: nn.scan (one layer body in the HLO) —
+    or, with ``cfg.pipe_mesh``, the GPipe pipeline path staging the same
+    shared block across the mesh's pipe axis (parallel/pipeline.py)."""
 
     cfg: AlbertConfig
     deterministic: bool = True
@@ -355,19 +441,101 @@ class AlbertEncoder(nn.Module):
     @nn.compact
     def __call__(self, hidden, attn_bias):
         cfg = self.cfg
-        # variable_broadcast shares the single layer's params across all
-        # iterations — exactly ALBERT's cross-layer weight sharing.
-        scan_layer = nn.scan(
-            _ScannedAlbertLayer,
-            variable_broadcast="params",
-            split_rngs={"params": False, "dropout": True},
-            in_axes=nn.broadcast,
-            length=cfg.num_hidden_layers,
-        )
-        hidden, _ = scan_layer(cfg, self.deterministic, name="layer")(
-            hidden, attn_bias
-        )
+        if cfg.pipe_mesh is not None:
+            hidden, moe_aux = self._pipelined(hidden, attn_bias)
+        else:
+            # variable_broadcast shares the single layer's params across all
+            # iterations — exactly ALBERT's cross-layer weight sharing.
+            scan_layer = nn.scan(
+                _ScannedAlbertLayer,
+                variable_broadcast="params",
+                split_rngs={"params": False, "dropout": True},
+                in_axes=nn.broadcast,
+                length=cfg.num_hidden_layers,
+            )
+            hidden, aux_ys = scan_layer(cfg, self.deterministic, name="layer")(
+                hidden, attn_bias
+            )
+            moe_aux = jnp.sum(aux_ys)
+        if cfg.moe_experts > 0:
+            # the trainer's loss_fn reads this via mutable=("losses",) and
+            # adds cfg.moe_aux_weight * moe_aux (Switch load balancing)
+            self.sow("losses", "moe_aux", moe_aux)
         return hidden
+
+    def _pipelined(self, hidden, attn_bias):
+        """Pipeline-parallel forward: num_hidden_layers/n_stages iterations
+        of the ONE shared block per stage, microbatches hopping stage→stage
+        (GPipe under shard_map). The param tree is created by the same
+        AlbertLayer init as the scan path, under the same names
+        (layer/block/...), so both paths share checkpoints and gradient
+        schemas. Composes with a "data" mesh axis (microbatch rows sharded
+        over it); "seq"/"model" axes and MoE need their own collectives
+        inside the stage and are rejected with the reason."""
+        from dedloc_tpu.parallel.pipeline import pipeline_apply, shared_stage_fn
+
+        cfg = self.cfg
+        mesh, axis = cfg.pipe_mesh, cfg.pipe_axis
+        n_stages = int(mesh.shape[axis])
+        if cfg.num_hidden_layers % n_stages:
+            raise ValueError(
+                f"num_hidden_layers ({cfg.num_hidden_layers}) must divide "
+                f"evenly into {n_stages} pipeline stages"
+            )
+        if cfg.moe_experts > 0:
+            raise ValueError(
+                "pipe_mesh + moe_experts unsupported: the expert all-to-all "
+                "would need its own axis inside the pipeline's shard_map"
+            )
+        if cfg.attention_impl == "ring":
+            raise ValueError(
+                "pipe_mesh + attention_impl='ring' unsupported: ring "
+                "attention opens its own shard_map over the seq axis"
+            )
+        if not self.deterministic and (
+            cfg.hidden_dropout_prob > 0.0 or cfg.attention_dropout_prob > 0.0
+        ):
+            raise ValueError(
+                "the pipeline path does not thread dropout rngs through "
+                "shard_map stages; use dropout 0 (the reference recipe)"
+            )
+        iters = cfg.num_hidden_layers // n_stages
+        B, S, H = hidden.shape
+        M = cfg.pipe_microbatches or 2 * n_stages
+        if B % M:
+            raise ValueError(
+                f"batch ({B}) must divide into pipe_microbatches ({M})"
+            )
+        layer = AlbertLayer(cfg, self.deterministic)
+        proto_x = jnp.zeros((B // M, S, H), hidden.dtype)
+        proto_b = jnp.zeros((B // M,) + attn_bias.shape[1:], attn_bias.dtype)
+        params = self.param(
+            "layer",
+            lambda rng: {"block": layer.init(rng, proto_x, proto_b)["params"]},
+        )
+
+        def block_fn(p, xb):
+            h, b = xb
+            h2, _aux = layer.apply({"params": p["block"]}, h, b)
+            return (h2, b)
+
+        if cfg.remat:
+            block_fn = jax.checkpoint(
+                block_fn, policy=remat_policy_object(cfg.remat_policy)
+            )
+        stage = shared_stage_fn(block_fn, iters)
+        micro = (
+            hidden.reshape(M, B // M, S, H),
+            jnp.broadcast_to(
+                attn_bias, (B,) + attn_bias.shape[1:]
+            ).reshape((M, B // M) + attn_bias.shape[1:]),
+        )
+        spec = P(None, "data") if "data" in mesh.axis_names else P()
+        out_h, _ = pipeline_apply(
+            stage, params, micro, mesh, axis=axis,
+            stacked_params=False, micro_spec=spec,
+        )
+        return out_h.reshape(B, S, H), jnp.zeros([], jnp.float32)
 
 
 class AlbertModel(nn.Module):

@@ -52,11 +52,12 @@ def pipeline_apply(
 ) -> jnp.ndarray:
     """Run ``microbatches`` through S pipelined stages; returns ``[M, ...]``.
 
-    stage_fn(params_s, x) -> y must keep the activation shape (a transformer
-    block, a stage of them, ...). ``microbatches`` is ``[M, ...]`` with M the
-    microbatch count; its non-leading dims may additionally be sharded over
-    other mesh axes (e.g. batch over "data") — the pipe loop is independent
-    of them. With ``stacked_params`` every leaf of ``stage_params`` has a
+    stage_fn(params_s, x) -> y must keep the activation structure (a
+    transformer block, a stage of them, ...). ``microbatches`` is ``[M, ...]``
+    with M the microbatch count — an array or a pytree of arrays sharing the
+    leading M (e.g. ``(hidden, attn_bias)`` when each microbatch carries its
+    own mask); non-leading dims may additionally be sharded over other mesh
+    axes (e.g. batch over "data") — the pipe loop is independent of them. With ``stacked_params`` every leaf of ``stage_params`` has a
     leading ``[S, ...]`` axis (place it with ``stage_param_sharding`` so the
     slice lives on its stage's device); otherwise params are taken as shared
     and replicated. ``micro_spec`` shards the microbatch array's *other*
@@ -75,7 +76,13 @@ def pipeline_apply(
     if axis in spec_axes:
         raise ValueError(f"micro_spec must not shard over the pipe axis {axis!r}")
     n_stages = mesh.shape[axis]
-    n_micro = microbatches.shape[0]
+    micro_leaves = jax.tree_util.tree_leaves(microbatches)
+    n_micro = micro_leaves[0].shape[0]
+    if any(leaf.shape[0] != n_micro for leaf in micro_leaves):
+        raise ValueError(
+            "every microbatch leaf needs the same leading microbatch count; "
+            f"got {[leaf.shape[0] for leaf in micro_leaves]}"
+        )
     if stacked_params:
         for path, leaf in jax.tree_util.tree_leaves_with_path(stage_params):
             if leaf.shape[:1] != (n_stages,):
@@ -99,35 +106,51 @@ def pipeline_apply(
 
     fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
 
+    tmap = jax.tree_util.tree_map
+
     def pipelined(params, micro):
         stage = jax.lax.axis_index(axis)
         if stacked_params:
             # shard_map hands each device its [1, ...] stage slice
-            params = jax.tree_util.tree_map(lambda p: p[0], params)
+            params = tmap(lambda p: p[0], params)
 
         # T = M + S - 1 ticks: feed zeros during the drain phase (stage 0
-        # ignores them once m >= M)
-        pad = jnp.zeros((n_stages - 1,) + micro.shape[1:], micro.dtype)
-        feed = jnp.concatenate([micro, pad], axis=0)
+        # ignores them once m >= M). micro may be a pytree (e.g. an
+        # (activation, per-microbatch-bias) pair) — every op below maps
+        # leaf-wise.
+        feed = tmap(
+            lambda m: jnp.concatenate(
+                [m, jnp.zeros((n_stages - 1,) + m.shape[1:], m.dtype)], axis=0
+            ),
+            micro,
+        )
 
         def tick(buf, x_in):
             # stage 0 ingests the next microbatch; others take the hop input
-            x = jnp.where(stage == 0, x_in, buf)
+            x = tmap(lambda i, b: jnp.where(stage == 0, i, b), x_in, buf)
             y = stage_fn(params, x)
             # last stage's result this tick IS a finished microbatch during
             # the drain window; everyone else forwards theirs down the pipe
             hopped = jax.lax.ppermute(y, axis, fwd_perm)
-            done = jnp.where(stage == n_stages - 1, y, jnp.zeros_like(y))
+            done = tmap(
+                lambda v: jnp.where(stage == n_stages - 1, v, jnp.zeros_like(v)),
+                y,
+            )
             return hopped, done
 
         # the carry is device-varying (each stage holds a different
         # activation) while the zeros literal is replicated — mark it so
         # the scan's carry type is stable under shard_map's VMA checks
-        buf0 = jax.lax.pcast(jnp.zeros_like(micro[0]), (axis,), to="varying")
+        buf0 = tmap(
+            lambda m: jax.lax.pcast(
+                jnp.zeros_like(m[0]), (axis,), to="varying"
+            ),
+            micro,
+        )
         _, dones = jax.lax.scan(tick, buf0, feed)
         # microbatch m finishes at tick m + S - 1 on the last stage; every
         # other device contributed zeros, so a psum replicates the result
-        outs = dones[n_stages - 1 : n_stages - 1 + n_micro]
+        outs = tmap(lambda d: d[n_stages - 1 : n_stages - 1 + n_micro], dones)
         return jax.lax.psum(outs, axis)
 
     return shard_map(

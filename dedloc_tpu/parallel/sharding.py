@@ -40,6 +40,14 @@ ALBERT_TP_RULES: Rules = (
     (r"\['mlm_bias'\]", P("model")),
 )
 
+# Expert parallelism for the Switch-MoE FFN variant (parallel/moe.py,
+# models/albert.py _moe_ffn): the expert-stacked FFN weights shard their
+# leading expert axis over the mesh's "expert" axis; the router stays
+# replicated. Concatenate with ALBERT_TP_RULES when both axes exist.
+ALBERT_EP_RULES: Rules = (
+    (r"\['moe_(wi|wo)'\]", P("expert")),
+)
+
 
 def spec_for_path(path_str: str, rules: Rules) -> P:
     for pattern, spec in rules:

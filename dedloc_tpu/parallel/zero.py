@@ -54,10 +54,17 @@ def opt_state_shardings(
     from jax.sharding import PartitionSpec as P
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+    rule_axes = {
+        name
+        for _, spec in (tp_rules or ())
+        for entry in tuple(spec)
+        for name in (entry if isinstance(entry, tuple) else (entry,))
+        if name is not None
+    }
     out = []
     for path, leaf in flat:
         spec = P()
-        if tp_rules is not None and "model" in mesh.axis_names:
+        if tp_rules is not None and rule_axes & set(mesh.axis_names):
             from dedloc_tpu.parallel.sharding import spec_for_path
 
             spec = spec_for_path(jax.tree_util.keystr(path), tp_rules)

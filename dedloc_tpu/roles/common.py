@@ -43,6 +43,12 @@ def build_model(
     attention_impl: str = "",
     vocab_size: int = 0,
     ring_mesh=None,
+    pipe_mesh=None,
+    pipe_microbatches: int = 0,
+    moe_experts: int = 0,
+    moe_mesh=None,
+    moe_capacity_factor: float = 0.0,
+    moe_aux_weight: float = -1.0,
 ) -> Tuple[AlbertConfig, AlbertForPreTraining]:
     overrides = {}
     if remat_policy:
@@ -56,6 +62,17 @@ def build_model(
         overrides["vocab_size"] = vocab_size
     if ring_mesh is not None:
         overrides["ring_mesh"] = ring_mesh
+    if pipe_mesh is not None:
+        overrides["pipe_mesh"] = pipe_mesh
+        overrides["pipe_microbatches"] = pipe_microbatches
+    if moe_experts:
+        overrides["moe_experts"] = moe_experts
+        if moe_mesh is not None:
+            overrides["moe_mesh"] = moe_mesh
+        if moe_capacity_factor > 0:
+            overrides["moe_capacity_factor"] = moe_capacity_factor
+        if moe_aux_weight >= 0:
+            overrides["moe_aux_weight"] = moe_aux_weight
     cfg = AlbertConfig.named(model_size)(**overrides)
     return cfg, AlbertForPreTraining(cfg)
 
@@ -133,28 +150,53 @@ def build_dht(args: CollaborationArguments, client_mode: Optional[bool] = None):
 
 def build_loss_fn(model: AlbertForPreTraining) -> Callable:
     """Gathered masked-position loss when the batch carries ``mlm_positions``
-    (the fast TPU layout); dense per-position loss otherwise."""
+    (the fast TPU layout); dense per-position loss otherwise. With an MoE
+    config the Switch load-balancing aux loss (sowed into the "losses"
+    collection by the encoder) is added at ``cfg.moe_aux_weight``."""
+    moe = getattr(model.cfg, "moe_experts", 0) > 0
 
     def loss_fn(params, batch, rng):
         gathered = "mlm_positions" in batch
-        mlm_logits, sop_logits = model.apply(
-            {"params": params},
-            batch["input_ids"],
-            batch["attention_mask"],
-            batch["token_type_ids"],
+        apply_kwargs = dict(
             mlm_positions=batch["mlm_positions"] if gathered else None,
         )
+        if moe:
+            (mlm_logits, sop_logits), mutated = model.apply(
+                {"params": params},
+                batch["input_ids"],
+                batch["attention_mask"],
+                batch["token_type_ids"],
+                mutable=("losses",),
+                **apply_kwargs,
+            )
+        else:
+            mlm_logits, sop_logits = model.apply(
+                {"params": params},
+                batch["input_ids"],
+                batch["attention_mask"],
+                batch["token_type_ids"],
+                **apply_kwargs,
+            )
         if gathered:
-            return albert_pretraining_loss_gathered(
+            loss, metrics = albert_pretraining_loss_gathered(
                 mlm_logits,
                 sop_logits,
                 batch["mlm_label_ids"],
                 batch["mlm_weights"],
                 batch["sop_labels"],
             )
-        return albert_pretraining_loss(
-            mlm_logits, sop_logits, batch["mlm_labels"], batch["sop_labels"]
-        )
+        else:
+            loss, metrics = albert_pretraining_loss(
+                mlm_logits, sop_logits, batch["mlm_labels"], batch["sop_labels"]
+            )
+        if moe:
+            aux = sum(
+                jnp.sum(leaf)
+                for leaf in jax.tree_util.tree_leaves(mutated["losses"])
+            )
+            loss = loss + model.cfg.moe_aux_weight * aux
+            metrics = dict(metrics, moe_aux=aux)
+        return loss, metrics
 
     return loss_fn
 

@@ -301,6 +301,7 @@ def test_round_failure_retries_then_applies_locally():
                 target_batch_size=64,
                 num_peers=2,
                 num_peers_at_step=2,
+                num_peers_near_step=2,
                 num_clients=0,
                 eta_next_step=0.0,
                 next_fetch_time=get_dht_time() + 60.0,
@@ -625,6 +626,7 @@ def test_step_aux_failed_round_keeps_step_and_retries_same_round():
                 target_batch_size=32,
                 num_peers=2,
                 num_peers_at_step=2,
+                num_peers_near_step=2,
                 num_clients=0,
                 eta_next_step=0.0,
                 next_fetch_time=get_dht_time() + 60.0,
@@ -695,6 +697,7 @@ def test_trainer_expected_group_size_includes_aux():
                 target_batch_size=64,
                 num_peers=2,
                 num_peers_at_step=2,
+                num_peers_near_step=2,
                 num_clients=0,
                 num_aux=1,
                 eta_next_step=0.0,
@@ -751,6 +754,7 @@ def test_trainer_plus_aux_group_is_not_averaging_progress():
                 target_batch_size=64,
                 num_peers=2,  # a partner trainer exists...
                 num_peers_at_step=2,  # ...at OUR step
+                num_peers_near_step=2,
                 num_clients=0,
                 num_aux=1,
                 eta_next_step=0.0,
@@ -821,20 +825,22 @@ def test_tracker_counts_peers_at_current_step():
         assert collab.optimizer_step == 20
         assert collab.num_peers_at_step == 1, collab
 
-        # one-behind counts as current: a partner that just applied the
-        # previous round reports its new step only at its next boundary
+        # one-behind counts as NEAR (short-grace sizing) but not at-step:
+        # a partner that just applied the previous round reports its new
+        # step only at its next boundary
         slow.report_local_progress(LocalProgress(
             step=19, samples_accumulated=1, samples_per_second=0.03,
             time=get_dht_time(), client_mode=True,
         ))
         deadline = time.time() + 10
         collab = fast.fetch_collaboration_state(force=True)
-        while collab.num_peers_at_step < 2 and time.time() < deadline:
+        while collab.num_peers_near_step < 2 and time.time() < deadline:
             time.sleep(0.1)
             collab = fast.fetch_collaboration_state(force=True)
-        assert collab.num_peers_at_step == 2, collab
+        assert collab.num_peers_near_step == 2, collab
+        assert collab.num_peers_at_step == 1, collab
 
-        # the slow peer catches up fully -> still counted
+        # the slow peer catches up fully -> at-step (full-window sizing)
         slow.report_local_progress(LocalProgress(
             step=20, samples_accumulated=1, samples_per_second=0.03,
             time=get_dht_time(), client_mode=True,
@@ -845,6 +851,7 @@ def test_tracker_counts_peers_at_current_step():
             time.sleep(0.1)
             collab = fast.fetch_collaboration_state(force=True)
         assert collab.num_peers_at_step == 2, collab
+        assert collab.num_peers_near_step == 2, collab
     finally:
         dht.shutdown()
 
@@ -877,9 +884,9 @@ def test_lagging_partner_does_not_stall_solo_rounds():
                 samples_accumulated=10**9,
                 target_batch_size=64,
                 num_peers=2,       # a partner exists...
-                num_peers_at_step=1,  # ...but it fell >1 step behind
-                # (resyncing) — one-behind partners count as current and
-                # take the networked path instead
+                num_peers_at_step=1,   # ...but it fell >1 step behind
+                num_peers_near_step=1,  # (resyncing) — near partners would
+                # instead take the networked path with a short grace
                 num_clients=1,
                 eta_next_step=0.0,
                 next_fetch_time=get_dht_time() + 60.0,
