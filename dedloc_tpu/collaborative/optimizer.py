@@ -431,6 +431,13 @@ class CollaborativeOptimizer:
 
     # -------------------------------------------------------- state recovery
 
+    def seed_state_sharing(self, state: TrainState) -> None:
+        """Publish a state snapshot BEFORE the first global step: a slow
+        partner that misses round 0 resyncs immediately instead of finding
+        no provider (the first post-apply backup takes tens of seconds on
+        slow device→host links) and silently diverging until one appears."""
+        self._backup_and_share(state)
+
     def _backup_and_share(self, state: TrainState) -> None:
         """Host snapshot of (params, opt_state) for late joiners
         (load_state_from_peers counterpart, run_trainer.py:124-128). The

@@ -276,6 +276,9 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
                 state.opt_state, opt_sharding or repl
             ),
         )
+    # share a pre-training snapshot: partners that miss the first rounds
+    # (slow hosts still compiling) must find a state provider immediately
+    opt.seed_state_sharing(state)
 
     loss_fn = build_loss_fn(model)
     accumulate = make_accumulate_step(
