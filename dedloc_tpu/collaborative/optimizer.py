@@ -185,6 +185,15 @@ class CollaborativeOptimizer:
         self._desynced = False
         self._round_failures = 0
         self.max_round_retries = 2
+        # staleness tolerance: a peer that slipped at most this many steps
+        # behind ADOPTS the global counter and keeps contributing gradients
+        # (computed on slightly-stale params — the bias is bounded and its
+        # averaging weight is its sample count); only a larger gap, or an
+        # explicit desync, triggers the full state download. Without this a
+        # slow volunteer in a fast collaboration lives in a resync loop: the
+        # download takes longer than the fast peer's round period, so it
+        # re-enters catch-up forever and never computes (round-5 sweep).
+        self.resync_step_gap = 8
         self._aux_misses = 0
         self._aux_withheld_at = 0.0
 
@@ -229,9 +238,17 @@ class CollaborativeOptimizer:
                 self._ema_started = True
 
             collab = self.tracker.fetch_collaboration_state()
-            if collab.optimizer_step > self.local_step or self._desynced:
-                # we fell behind (or our last round failed while others
-                # averaged) — catch up from peers
+            gap = collab.optimizer_step - self.local_step
+            if (
+                gap > self.resync_step_gap
+                or self._desynced
+                # never been synced at all (fresh init joining a live run):
+                # stale-tolerance is for peers that HAVE the collaboration's
+                # state modulo a few applies, not for random-init params
+                or (gap > 0 and self.local_step == 0)
+            ):
+                # we fell FAR behind (or our last round failed while others
+                # averaged) — catch up from peers: full state download
                 state = self._catch_up(state, collab)
                 self._desynced = False
                 grad_acc = zeros_like_grads(state.params)
@@ -239,6 +256,14 @@ class CollaborativeOptimizer:
                 self.local_samples_accumulated = 0
                 self._report(synced=True)
                 return state, grad_acc, n_acc, False
+            if gap > 0:
+                # mildly stale: adopt the counter and KEEP the accumulated
+                # gradients — contribute them to the current round instead
+                # of burning a state download that outlasts the fast peer's
+                # round period (the resync-loop failure mode; see
+                # resync_step_gap above). Our params lag by <= gap applies;
+                # the gradient bias is bounded and weighted by our samples.
+                self.local_step = collab.optimizer_step
 
             self._report(synced=True)
             if not collab.ready_for_step:
@@ -249,7 +274,7 @@ class CollaborativeOptimizer:
             # not fire while a partner is mid-round
             collab = self.tracker.fetch_collaboration_state(force=True)
             if collab.optimizer_step > self.local_step:
-                return state, grad_acc, n_acc, False  # catch up next boundary
+                self.local_step = collab.optimizer_step  # raced again: rejoin
             if not collab.ready_for_step:
                 return state, grad_acc, n_acc, False
             return self._global_step(state, grad_acc, n_acc, collab)
