@@ -502,17 +502,28 @@ class AlbertEncoder(nn.Module):
         iters = cfg.num_hidden_layers // n_stages
         B, S, H = hidden.shape
         M = cfg.pipe_microbatches or 2 * n_stages
-        if B % M:
-            raise ValueError(
-                f"batch ({B}) must divide into pipe_microbatches ({M})"
-            )
         layer = AlbertLayer(cfg, self.deterministic)
-        proto_x = jnp.zeros((B // M, S, H), hidden.dtype)
-        proto_b = jnp.zeros((B // M,) + attn_bias.shape[1:], attn_bias.dtype)
+        proto_x = jnp.zeros((max(1, B // M), S, H), hidden.dtype)
+        proto_b = jnp.zeros(
+            (max(1, B // M),) + attn_bias.shape[1:], attn_bias.dtype
+        )
         params = self.param(
             "layer",
             lambda rng: {"block": layer.init(rng, proto_x, proto_b)["params"]},
         )
+        if self.is_initializing():
+            # init runs with the PER-DEVICE batch (roles init that way so
+            # param shapes come cheap) — the pipeline schedule is
+            # irrelevant to parameter creation, so apply the block
+            # sequentially for the init-time forward value
+            h = hidden
+            for _ in range(cfg.num_hidden_layers):
+                h, _aux = layer.apply({"params": params["block"]}, h, attn_bias)
+            return h, jnp.zeros([], jnp.float32)
+        if B % M:
+            raise ValueError(
+                f"batch ({B}) must divide into pipe_microbatches ({M})"
+            )
 
         def block_fn(p, xb):
             h, b = xb

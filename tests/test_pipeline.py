@@ -151,7 +151,7 @@ def test_albert_shared_layer_pipelined(rng):
     lparams = layer.init(jax.random.PRNGKey(0), hidden, attn_bias)["params"]
 
     def block_fn(p, x):
-        return layer.apply({"params": p}, x, attn_bias)
+        return layer.apply({"params": p}, x, attn_bias)[0]
 
     total_iters = 8
     mesh = make_mesh(4, axis_names=("pipe",))

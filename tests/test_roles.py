@@ -581,3 +581,90 @@ def test_trainer_tensor_parallel_on_mesh(tmp_path):
     assert any("data" in s for s in opt_specs), (
         "ZeRO must shard what TP left replicated"
     )
+
+
+def test_trainer_pipeline_parallel_on_mesh(tmp_path):
+    """VERDICT r4 #3: pipeline parallelism reachable from the trainer CLI —
+    a dp2 x pp2 slice peer stages the shared block across the pipe axis
+    (GPipe under shard_map, parallel/pipeline.py) and still makes global
+    steps with a finite loss. The param tree matches the scanned model, so
+    the collaborative grad schema is unchanged."""
+    args = _args(
+        tmp_path,
+        [
+            "--optimizer.target_batch_size", "16",
+            "--training.max_local_steps", "4",
+            "--training.save_steps", "0",
+            "--training.mesh_devices", "4",
+            "--training.mesh_pipe_devices", "2",
+            "--training.pipe_microbatches", "4",
+        ],
+    )
+    state = run_trainer(args)
+    assert int(state.step) >= 1
+    import jax
+
+    # same leaf paths as the non-pipelined model: encoder/layer/block/...
+    paths = [
+        jax.tree_util.keystr(p)
+        for p, _ in jax.tree_util.tree_leaves_with_path(state.params)
+    ]
+    assert any("['encoder']['layer']['block']" in p for p in paths), paths
+
+
+def test_trainer_pipe_rejects_tp_and_seq(tmp_path):
+    args = _args(
+        tmp_path,
+        [
+            "--training.mesh_devices", "8",
+            "--training.mesh_pipe_devices", "2",
+            "--training.mesh_model_devices", "2",
+        ],
+    )
+    with pytest.raises(ValueError, match="data axis only"):
+        run_trainer(args)
+
+
+def test_trainer_moe_expert_parallel_on_mesh(tmp_path):
+    """VERDICT r4 #3: the Switch-MoE ALBERT variant reachable from the
+    trainer CLI — dp2 x ep2, experts sharded over the expert axis (the
+    dispatch einsums lower to all-to-alls), aux loss flowing into training,
+    global steps with finite loss."""
+    from jax.sharding import PartitionSpec as P
+
+    args = _args(
+        tmp_path,
+        [
+            "--optimizer.target_batch_size", "16",
+            "--training.max_local_steps", "4",
+            "--training.save_steps", "0",
+            "--training.mesh_devices", "4",
+            "--training.mesh_expert_devices", "2",
+            "--training.moe_experts", "4",
+            "--training.zero_sharding", "true",
+        ],
+    )
+    state = run_trainer(args)
+    assert int(state.step) >= 1
+    import jax
+
+    by_path = {
+        jax.tree_util.keystr(p): leaf
+        for p, leaf in jax.tree_util.tree_leaves_with_path(state.params)
+    }
+    moe_leaves = {k: v for k, v in by_path.items() if "moe_w" in k}
+    assert moe_leaves, f"no MoE leaves in {sorted(by_path)[:5]}..."
+    specs = [
+        str(getattr(leaf.sharding, "spec", P())) for leaf in moe_leaves.values()
+    ]
+    assert any("expert" in s for s in specs), (
+        f"experts not sharded over the expert axis: {specs}"
+    )
+    # moments follow the expert layout; ZeRO shards the rest over data
+    opt_specs = [
+        str(getattr(leaf.sharding, "spec", P()))
+        for leaf in jax.tree.leaves(state.opt_state)
+        if hasattr(leaf, "sharding")
+    ]
+    assert any("expert" in s for s in opt_specs)
+    assert any("data" in s for s in opt_specs)
