@@ -240,7 +240,17 @@ class DHTNode:
             logger.debug(f"record for key {key!r} failed local validation")
             return False
 
-        nearest = await self.find_nearest_nodes(key_id, k=self.num_replicas)
+        # look up WIDER than the replica set (classic Kademlia: k-wide
+        # lookup, then pick the replicas): with small buckets the iterative
+        # search needs the extra frontier to converge on the true global
+        # nearest set — a k=num_replicas lookup from a sparse table can
+        # settle on a locally-nearest set that misses the real one, and
+        # store/get would then disagree about where the record lives
+        nearest = (
+            await self.find_nearest_nodes(
+                key_id, k=max(self.bucket_size, self.num_replicas)
+            )
+        )[: self.num_replicas]
         stored_anywhere = False
         # self-store if we are closer than the furthest replica (or low pop.)
         if not self.client_mode and (
@@ -289,7 +299,14 @@ class DHTNode:
             else:
                 best_value = local
 
-        nearest = await self.find_nearest_nodes(key_id, k=self.num_replicas)
+        # wide lookup for the same reason as in store(); query a couple of
+        # nodes beyond the replica count so one stale/missed replica does
+        # not turn into a lost record
+        nearest = (
+            await self.find_nearest_nodes(
+                key_id, k=max(self.bucket_size, self.num_replicas)
+            )
+        )[: self.num_replicas + 2]
         replies = await asyncio.gather(
             *(
                 self.client.call(
