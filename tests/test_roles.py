@@ -166,8 +166,22 @@ def test_two_trainer_roles_collaborate(tmp_path):
                     tmp_path,
                     [
                         "--dht.initial_peers", addr,
-                        "--optimizer.target_batch_size", "16",
-                        "--training.max_local_steps", "14",
+                        # target sized so a round takes SECONDS (~13 solo
+                        # boundaries): sub-second rounds sit below the DHT
+                        # record-propagation latency, where a fast peer's
+                        # solo cadence can outrun the partner's visibility
+                        # no matter how long both run — the protocol
+                        # targets the coordinated regime (real rounds are
+                        # 5s+), so the test must too
+                        "--optimizer.target_batch_size", "256",
+                        # budget must keep BOTH peers stepping through
+                        # cold-start skew AND round-assembly waits:
+                        # boundaries are ~0.25s and keep being consumed
+                        # while the global target fills, so a small budget
+                        # expires mid-collaboration (a peer once exited
+                        # 0.6s after the first joint round, stranding its
+                        # partner into two failed windows)
+                        "--training.max_local_steps", "600",
                         "--training.save_steps", "0",
                         "--training.output_dir", str(tmp_path / f"peer{idx}"),
                         "--training.seed", str(idx),
@@ -185,7 +199,7 @@ def test_two_trainer_roles_collaborate(tmp_path):
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=120)
+            t.join(timeout=240)
         assert not errors, errors
         assert len(results) == 2
         assert max(int(s.step) for s in results.values()) >= 1
@@ -432,8 +446,12 @@ def test_client_mode_trainer_collaborates_via_relay(tmp_path):
                     tmp_path,
                     [
                         "--dht.initial_peers", addr,
-                        "--optimizer.target_batch_size", "16",
-                        "--training.max_local_steps", "14",
+                        # seconds-scale rounds + a budget that outlasts
+                        # compile skew and round-assembly waits, for the
+                        # same reasons as in
+                        # test_two_trainer_roles_collaborate above
+                        "--optimizer.target_batch_size", "256",
+                        "--training.max_local_steps", "600",
                         "--training.save_steps", "0",
                         "--training.output_dir", str(tmp_path / f"rp{idx}"),
                         "--training.seed", str(idx),
@@ -456,7 +474,7 @@ def test_client_mode_trainer_collaborates_via_relay(tmp_path):
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=120)
+            t.join(timeout=240)
         assert not errors, errors
         assert len(results) == 2
         assert max(int(s.step) for s in results.values()) >= 1
