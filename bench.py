@@ -339,13 +339,16 @@ def main() -> None:
 
     fused_ln = fused_ln_for_policy(remat)
     per_step_env = int(os.environ.get("DEDLOC_BENCH_BATCH", "0"))
+    # flash-kernel tile sweep knob (perf probes; 512 is the shipped recipe)
+    attn_block = int(os.environ.get("DEDLOC_BENCH_ATTN_BLOCK", "512"))
     if tiny:  # CI smoke on CPU
         cfg = AlbertConfig.tiny(remat_policy=remat, attention_impl=impl,
                                 fused_ln=fused_ln)
         accum, per_step, seq, iters = 2, 4, 64, 3
     else:
         cfg = AlbertConfig.large(remat_policy=remat, attention_impl=impl,
-                                 fused_ln=fused_ln)
+                                 fused_ln=fused_ln,
+                                 attention_block_size=attn_block)
         # iters per block: one scalar readback (~90 ms tunnel RTT) per block,
         # so longer blocks report closer to the true device rate
         accum, per_step, seq, iters = 16, 12, 512, 10
