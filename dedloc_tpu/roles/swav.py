@@ -132,6 +132,29 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
         post_apply=make_prototype_post_apply(),
         verbose=True,
     )
+    # disk resume (same contract as the ALBERT trainer): newest checkpoint
+    # restores params + batch_stats and seeds the collaborative counter; a
+    # LIVE collaboration below still wins. LARC momentum is not part of the
+    # swav checkpoint (the reference's vissl phase resume also rebuilds the
+    # optimizer) — it re-warms within a few steps.
+    from dedloc_tpu.collaborative.optimizer import _named_to_tree
+    from dedloc_tpu.utils.checkpoint import load_latest_checkpoint
+
+    resumed = load_latest_checkpoint(t.output_dir)
+    if resumed is not None:
+        ckpt_step, tree, meta = resumed
+        template = jax.device_get((state.params, batch_stats))
+        try:
+            params_t, bs_t = _named_to_tree(tree, template)
+            state = state.replace(
+                step=jnp.asarray(ckpt_step, jnp.int32),
+                params=jax.device_put(params_t),
+            )
+            batch_stats = jax.device_put(bs_t)
+            opt.local_step = int(meta.get("local_step", ckpt_step))
+            logger.info(f"resumed from local checkpoint at step {ckpt_step}")
+        except (KeyError, ValueError) as e:
+            logger.warning(f"checkpoint incompatible ({e!r}); starting fresh")
     state = opt.load_state_from_peers(state)
 
     accumulate = make_swav_accumulate_step(

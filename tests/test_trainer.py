@@ -108,3 +108,45 @@ def test_swav_role_end_to_end(tmp_path):
     # the queue path was actually crossed (queue_start_step=1 semantics,
     # swav_1node_resnet_submit.yaml:95): not just configured, ENGAGED
     assert any("queue engaged" in m for m in records), records
+
+
+def test_swav_role_resumes_from_checkpoint(tmp_path):
+    """Disk resume parity with the ALBERT trainer (round 5): the newest
+    checkpoint restores params+batch_stats and seeds the collaborative
+    counter, so a restarted SwAV peer (or a solo continuation of a fleet
+    run) picks up where the run left off instead of from scratch."""
+    import logging
+
+    from dedloc_tpu.core.config import SwAVCollaborationArguments, parse_config
+    from dedloc_tpu.roles.swav import run_swav
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logging.getLogger("dedloc_tpu").addHandler(_Capture())
+    argv = [
+        "--dht.listen_host", "127.0.0.1",
+        "--training.model_size", "tiny",
+        "--training.per_device_batch_size", "2",
+        "--training.gradient_accumulation_steps", "2",
+        "--training.max_local_steps", "4",
+        "--training.warmup_steps", "2",
+        "--training.total_steps", "50",
+        "--training.save_steps", "1",
+        "--training.output_dir", str(tmp_path / "out"),
+        "--optimizer.target_batch_size", "8",
+        "--averager.averaging_expiration", "1.0",
+    ]
+    run_swav(parse_config(SwAVCollaborationArguments, argv))
+    first_steps = [m for m in records if "applied" in m]
+    assert first_steps, "first run made no global steps"
+    records.clear()
+    run_swav(parse_config(SwAVCollaborationArguments, argv))
+    resumed = [m for m in records if "resumed from local checkpoint" in m]
+    assert resumed, f"no resume log; got {records[:10]}"
+    # the counter continued: the second run's first applied step is past 1
+    applied = [m for m in records if "applied" in m]
+    assert applied and "step 1 " not in applied[0], applied[:3]

@@ -24,13 +24,15 @@ TARGET=${TARGET:-16}           # solo recipe scale (r4 sustained run)
 TOTAL=${TOTAL:-4800}
 CHURN=${CHURN:-2400}
 REJOIN=${REJOIN:-300}
+SAVE_STEPS=${SAVE_STEPS:-50}
+QUEUE_START=${QUEUE_START:-400}
 mkdir -p "$RUN"
 
 COMMON="--dht.experiment_prefix $PREFIX --optimizer.target_batch_size $TARGET \
   --averager.averaging_expiration $WINDOW --averager.averaging_timeout 180 \
   --training.learning_rate 0.15 --training.warmup_steps 200 \
   --training.total_steps 2500 \
-  --training.queue_length 3840 --training.queue_start_step 400"
+  --training.queue_length 3840 --training.queue_start_step $QUEUE_START"
 
 log() { echo "[orc] $(date +%T) $*" | tee -a "$RUN/orchestrator.log"; }
 
@@ -49,7 +51,7 @@ python -m dedloc_tpu.roles.swav $COMMON \
   --averager.listen_port "$TPU_AVG_PORT" \
   --training.image_folder "$CORPUS/swav_images" \
   --training.per_device_batch_size 16 \
-  --training.save_steps 250 \
+  --training.save_steps "$SAVE_STEPS" \
   --training.output_dir "$RUN/outputs" --training.seed 0 \
   > "$RUN/swav_tpu.log" 2>&1 &
 TPU=$!
