@@ -435,6 +435,15 @@ def test_records_survive_original_holder_churn():
             set_dht_time_offset(3600.0)
             for n in originals + newcomers:
                 await n.run_maintenance()
+            # under real-time RPC timeouts a republication can be dropped on
+            # a loaded host; the production maintenance loop is periodic, so
+            # mirror it: extra passes until a newcomer holds the record
+            for _ in range(5):
+                if any(n.storage.get(b"model_meta") is not None
+                       for n in newcomers):
+                    break
+                for n in originals + newcomers:
+                    await n.run_maintenance()
 
             # every ORIGINAL node dies (incl. all original replica holders)
             await _shutdown(originals)
