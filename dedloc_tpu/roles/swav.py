@@ -190,6 +190,20 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
                     f"(queue_start_step={cfg.queue_start_step}, "
                     f"length={cfg.queue_length})"
                 )
+                if cfg.queue_start_step < 2 * t.warmup_steps:
+                    # measured negative (BASELINE.md round 5): engaging the
+                    # queue on a near-random trunk fills it with embeddings
+                    # that mislead sinkhorn and collapse the representation
+                    # (linear probe BELOW the random-trunk control); the
+                    # reference engages its queue deep into training
+                    # (swav/README.md:28, queue.start_iter ~98-100k)
+                    logger.warning(
+                        "queue engaged before the trunk is trained "
+                        f"(start {cfg.queue_start_step} < 2x warmup "
+                        f"{t.warmup_steps}); stale near-random embeddings "
+                        "can collapse the representation — prefer a later "
+                        "--training.queue_start_step"
+                    )
             local["grad_acc"], local["n_acc"], local["batch_stats"], \
                 local["queue"], metrics = accumulate(
                     state.params,
