@@ -513,6 +513,24 @@ class DecentralizedAverager:
         candidates.sort(key=lambda c: -c[0])
         return [ep for _step, ep in candidates]
 
+    def best_advertised_state_step(self) -> Optional[int]:
+        """Deepest global step any live provider ADVERTISES in its KB-sized
+        DHT record — lets a resumed peer decide whether a download could
+        possibly be newer than its checkpoint without pulling the full
+        multi-hundred-MB state blob. None when nobody shares."""
+        entry = self.dht.get(f"{self.prefix}_state_providers", latest=True)
+        if entry is None or not hasattr(entry.value, "items"):
+            return None
+        steps = []
+        for sk, v in entry.value.items():
+            if sk == getattr(self, "peer_id", None):
+                continue
+            try:
+                steps.append(int(v.value.get("step", 0)))
+            except Exception:  # noqa: BLE001
+                continue
+        return max(steps) if steps else None
+
     def load_state_from_peers(
         self, timeout: float = 60.0
     ) -> Optional[Tuple[Dict[str, Any], Dict[str, np.ndarray]]]:

@@ -108,6 +108,10 @@ class CollaborativeOptimizer:
         post_apply: Optional[Callable[[TrainState], TrainState]] = None,
         authorizer=None,  # token authorizer for gated public runs
         authority_public_key: Optional[bytes] = None,
+        contrib_clip_per_sample: float = 0.0,  # cap the contributed
+        # per-MICRO-batch mean grad at clip*(samples/micro-batch) before
+        # averaging — tiny-batch peers inject high-per-sample-energy noise
+        # otherwise (core/config.py CollaborativeOptimizerArguments)
     ):
         assert not (client_mode and auxiliary), "an auxiliary peer must listen"
         self.tx = tx
@@ -119,6 +123,7 @@ class CollaborativeOptimizer:
         self.auxiliary = auxiliary
         self.verbose = verbose
         self.statistics_expiration = statistics_expiration
+        self.contrib_clip_per_sample = float(contrib_clip_per_sample)
 
         self.averager = DecentralizedAverager(
             dht,
@@ -295,6 +300,26 @@ class CollaborativeOptimizer:
         round_id = f"step{collab.optimizer_step}"
         n = max(int(jax.device_get(n_acc)), 1)
         mean_grads = jax.tree.map(lambda g: g / n, grad_acc)
+        if self.contrib_clip_per_sample > 0:
+            # cap what we contribute to the round: sample-weighted averaging
+            # assumes equal per-sample gradient quality, so the cap scales
+            # with OUR samples per MICRO-batch (mean_grads = grad_acc/n_acc
+            # is a per-micro-batch mean) — it self-calibrates across peer
+            # batch sizes, never binds a healthy peer, and suppresses the
+            # tiny-batch sinkhorn-noise outlier (measured 19x per-sample
+            # energy at B=2; see core/config.py). Runs on device: one
+            # global-norm reduce + scale, ~free next to the grad device_get.
+            cap = self.contrib_clip_per_sample * max(
+                float(self.local_samples_accumulated) / n, 1.0
+            )
+            gnorm = jax.numpy.sqrt(
+                sum(
+                    jax.numpy.vdot(g, g).real
+                    for g in jax.tree.leaves(mean_grads)
+                )
+            )
+            scale = jax.numpy.minimum(1.0, cap / (gnorm + 1e-12))
+            mean_grads = jax.tree.map(lambda g: g * scale, mean_grads)
 
         alone_grace = (
             get_dht_time() - self._created_at
@@ -536,23 +561,55 @@ class CollaborativeOptimizer:
             )
         return jax.device_put(tree)
 
-    def load_state_from_peers(self, state: TrainState) -> TrainState:
+    def load_state_from_peers(
+        self, state: TrainState, only_if_newer: bool = False
+    ) -> TrainState:
         """Download the newest collaboration state (params+opt) from a peer
         (albert/run_trainer.py:124-128 on_train_begin semantics). Returns the
-        local state unchanged if nobody shares yet."""
+        local state unchanged if nobody shares yet.
+
+        ``only_if_newer`` — adopt the remote state only when its step is
+        STRICTLY deeper than ``self.local_step``. Role startup after a disk
+        resume must pass True: a fresh-init partner that raced a few counter
+        steps ahead while this peer was still compiling must not beat a
+        770-step checkpoint (measured: the resumed peer silently demoted
+        itself to the fresh peer's near-random params and the run collapsed).
+        Catch-up/resync paths keep the unconditional adopt — a desynced peer
+        wants the collaboration's canonical state even at the same step."""
         self._join_backup()
+        if only_if_newer:
+            # KB-cheap pre-check against the advertised provider steps: a
+            # resumed peer usually HAS the deepest state, and downloading a
+            # full params+opt blob only to discard it wastes the provider's
+            # uplink (advisor r5). The post-download check below still
+            # guards the race where the advertisement was newer than the
+            # state actually served.
+            best = self.averager.best_advertised_state_step()
+            if best is not None and best <= self.local_step:
+                logger.info(
+                    f"best advertised peer state (step {best}) is not newer "
+                    f"than local {self.local_step}; keeping local state"
+                )
+                return state
         result = self.averager.load_state_from_peers()
         if result is None:
             logger.info("no state providers found; starting from local state")
             return state
         metadata, named = result
+        remote_step = int(metadata.get("local_step", metadata.get("step", 0)))
+        if only_if_newer and remote_step <= self.local_step:
+            logger.info(
+                f"peer state at global step {remote_step} is not newer than "
+                f"local {self.local_step}; keeping local state"
+            )
+            return state
         template = jax.device_get((state.params, state.opt_state))
         try:
             params, opt_state = _named_to_tree(named, template)
         except (KeyError, ValueError) as e:
             logger.warning(f"peer state incompatible ({e!r}); keeping local")
             return state
-        self.local_step = int(metadata.get("local_step", metadata.get("step", 0)))
+        self.local_step = remote_step
         new_state = state.replace(
             step=jax.numpy.asarray(int(metadata.get("step", 0)), jax.numpy.int32),
             params=self._device_put(params, self.param_sharding),

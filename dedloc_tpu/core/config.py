@@ -185,6 +185,21 @@ class CollaborativeOptimizerArguments:
     # serve model+opt state to late joiners (p2p state transfer); turn off on
     # solo/benchmark runs to keep the device↔host link free for dispatch
     allow_state_sharing: bool = True
+    # cap each peer's CONTRIBUTED per-micro-batch mean gradient at
+    # clip * (samples per micro-batch) before averaging (0 = off) — the
+    # contributed tree is grad_acc / n_acc where n_acc counts MICRO-batches,
+    # so with gradient accumulation the cap pairs with the micro-batch
+    # sample count, not the boundary total. Sample-
+    # weighted averaging assumes equal per-sample gradient quality; a
+    # tiny-batch peer violates that hard (measured on SwAV ResNet-50 at
+    # init: a B=2 boundary mean has global norm 56.7 = 28.4/sample vs a
+    # B=16 one at 23.6 = 1.47/sample — 19x the per-sample energy, nearly
+    # all sinkhorn noise) and its noise steers the group's averaged
+    # direction. The cap is linear in the peer's own samples, so it
+    # self-calibrates across batch sizes: at 2.0/sample it never binds a
+    # healthy B=16 peer (1.47 at init, 0.31 trained) and suppresses the
+    # B=2 outlier 14x. SwAV runs default it on (roles/swav.py).
+    contrib_clip_per_sample: float = 0.0
 
 
 @dataclass
@@ -332,7 +347,13 @@ class SwAVCollaborationArguments:
     averager: AveragerArguments = field(default_factory=AveragerArguments)
     optimizer: CollaborativeOptimizerArguments = field(
         default_factory=lambda: CollaborativeOptimizerArguments(
-            target_batch_size=32768
+            target_batch_size=32768,
+            # sinkhorn gradients from tiny-batch volunteers are high-energy
+            # noise (see contrib_clip_per_sample) — SwAV defaults the
+            # contribution clip ON; ALBERT keeps it off (LAMB's apply-side
+            # max_grad_norm already bounds that path and the converged
+            # recipe predates the knob)
+            contrib_clip_per_sample=2.0,
         )
     )
     training: SwAVTrainingArguments = field(

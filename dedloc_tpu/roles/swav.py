@@ -93,6 +93,20 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
         mesh = make_mesh(t.mesh_devices, device_offset=t.mesh_device_offset)
         logger.info(f"swav slice mesh: {mesh.shape}")
     slice_batch = t.per_device_batch_size * slice_factor
+    if slice_batch < 8:
+        # sinkhorn equipartitions THIS peer's local batch over the
+        # prototypes: at a handful of global-crop embeddings the transport
+        # is pure noise and the peer's gradients carry ~19x the per-sample
+        # energy of a B=16 peer (measured at init; core/config.py
+        # contrib_clip_per_sample). The clip bounds the damage, but such a
+        # peer contributes little signal — prefer a larger batch or aux.
+        logger.warning(
+            f"per-peer batch {slice_batch} is too small for a stable "
+            "sinkhorn assignment; this peer's gradients will be mostly "
+            "noise (clipped by optimizer.contrib_clip_per_sample). "
+            "Raise --training.per_device_batch_size (>=8) or join as an "
+            "aux bandwidth donor instead."
+        )
 
     rng = jax.random.PRNGKey(t.seed)
     init_crops = [
@@ -124,6 +138,7 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
         averaging_timeout=args.averager.averaging_timeout,
         metadata_expiration=args.averager.metadata_expiration,
         statistics_expiration=args.optimizer.statistics_expiration,
+        contrib_clip_per_sample=args.optimizer.contrib_clip_per_sample,
         client_mode=args.dht.client_mode,
         relay=args.dht.relay or None,
         listen_port=args.averager.listen_port,
@@ -155,7 +170,18 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
             logger.info(f"resumed from local checkpoint at step {ckpt_step}")
         except (KeyError, ValueError) as e:
             logger.warning(f"checkpoint incompatible ({e!r}); starting fresh")
-    state = opt.load_state_from_peers(state)
+            resumed = None  # genuinely fresh: keep cold-start adoption below
+    # a DEEPER live collaboration wins over the disk checkpoint; the
+    # reverse race (fresh partner raced ahead while we compiled) must not
+    # (only_if_newer — see load_state_from_peers). Cold starts keep the
+    # unconditional adopt so simultaneous fresh replicas begin identical.
+    state = opt.load_state_from_peers(
+        state, only_if_newer=resumed is not None
+    )
+    # share a pre-training snapshot (same as the ALBERT trainer): partners
+    # that start while this peer is still compiling must find a provider —
+    # and a resumed peer's deep state must be visible before its first step
+    opt.seed_state_sharing(state)
 
     accumulate = make_swav_accumulate_step(
         model, cfg, mesh=mesh, num_crop_groups=len(spec.sizes)

@@ -238,6 +238,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         averaging_timeout=args.averager.averaging_timeout,
         metadata_expiration=args.averager.metadata_expiration,
         statistics_expiration=args.optimizer.statistics_expiration,
+        contrib_clip_per_sample=args.optimizer.contrib_clip_per_sample,
         min_refresh_period=args.averager.min_refresh_period,
         max_refresh_period=args.averager.max_refresh_period,
         default_refresh_period=args.averager.default_refresh_period,
@@ -257,11 +258,17 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         verbose=True,
     )
     # catch up with the collaboration before training (:124-128)
-    # disk-resume seeds the collaborative counter; a LIVE collaboration
-    # (state providers) below still wins — load_state_from_peers overwrites
-    # local_step when a newer peer state exists
+    # disk-resume seeds the collaborative counter; a DEEPER live
+    # collaboration below still wins — only_if_newer guards the reverse
+    # race (a fresh partner that advanced the counter while we compiled
+    # must not beat the resumed checkpoint)
     opt.local_step = max(opt.local_step, resumed_local_step)
-    state = opt.load_state_from_peers(state)
+    # only_if_newer ONLY when a checkpoint was actually restored: a fresh
+    # cold-start peer must still adopt a same-step provider's params so
+    # simultaneously-starting replicas begin identical
+    state = opt.load_state_from_peers(
+        state, only_if_newer=resumed_local_step > 0
+    )
     if mesh is not None:
         # commit state onto the mesh once — otherwise accumulate's
         # replicated in_shardings would re-broadcast the full params from
