@@ -14,6 +14,14 @@ Roles inside a group (capability parity with the reference):
 - client-mode peer (arguments.py:63-65): bandwidth == 0 — sends data and
   pulls results, hosts nothing (outbound connections only)
 
+Weights are arbitrary non-negative floats, not just sample counts: the
+collaborative optimizer's contribution ramp scales a freshly-joined peer's
+weight from near-zero to its full sample count over its first ramp_rounds
+rounds, and its trunk-health gate sends weight 0.0 for a diverged peer —
+such a peer rides the aux wire path (zero-weight marker, no data) but still
+gathers the group's reduced spans, i.e. it RECEIVES the average it did not
+perturb.
+
 Failure contract (mirrors the reference's straggler SLA,
 albert/arguments.py:23-28): a SENDER that misses the ``straggler_timeout``
 window is simply left out — hosts reduce whatever arrived by then, and all

@@ -12,8 +12,9 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import hashlib
 import threading
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -34,6 +35,7 @@ from dedloc_tpu.core.serialization import (
 from dedloc_tpu.core.timeutils import get_dht_time
 from dedloc_tpu.dht.dht import DHT
 from dedloc_tpu.dht.protocol import RPCClient, RPCError, RPCServer
+from dedloc_tpu.testing import faults
 from dedloc_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -43,8 +45,6 @@ def schema_fingerprint(tree: Dict[str, np.ndarray]) -> bytes:
     """Order-independent hash of (name, shape, dtype) — the join-time
     compatibility handshake: peers whose trees cannot all-reduce together
     are refused by leaders instead of failing a span assert mid-round."""
-    import hashlib
-
     h = hashlib.sha256()
     for name in sorted(tree):
         arr = tree[name]
@@ -78,6 +78,11 @@ class DecentralizedAverager:
         # k-redundant and the advertised endpoint fails over when the
         # primary relay dies. Listening peers all serve as relays.
         relay_keepalive_period: float = 5.0,
+        # state-sync retry budget: a dead or corrupt provider costs one
+        # exponential backoff instead of a failed join (see
+        # load_state_from_peers)
+        state_sync_retries: int = 2,
+        state_sync_backoff: float = 0.5,
     ):
         if relay and not client_mode:
             # a listening peer IS a relay; accepting (and dropping) the flag
@@ -101,10 +106,14 @@ class DecentralizedAverager:
         self.averaging_timeout = averaging_timeout
         self.target_group_size = target_group_size
         self.relay_keepalive_period = relay_keepalive_period
+        self.state_sync_retries = int(state_sync_retries)
+        self.state_sync_backoff = float(state_sync_backoff)
         self._listen = (listen_host, listen_port)
         self._advertised_host = advertised_host or "127.0.0.1"
         self._shared_state: Optional[Tuple[Dict[str, np.ndarray], Dict[str, Any]]] = None
-        self._shared_state_blob: Optional[bytes] = None
+        # serialized snapshot cache: (blob, sha256 digest) — the digest rides
+        # every state.get reply so downloaders detect truncation/corruption
+        self._shared_state_blob: Optional[Tuple[bytes, bytes]] = None
         self._state_lock = threading.Lock()
         self._serialize_task: Optional[asyncio.Task] = None
         self.server: Optional[RPCServer] = None
@@ -345,6 +354,15 @@ class DecentralizedAverager:
         failed and the caller should proceed with its local values
         (reference semantics: a failed group costs one round, nothing else).
 
+        ``weight`` is this peer's averaging weight — normally its accumulated
+        sample count. The contribution ramp / trunk-health gate
+        (collaborative optimizer) scale it down for freshly-joined or
+        diverged peers: a reduced weight mixes proportionally less into the
+        group mean, and ``weight == 0.0`` contributes NOTHING while still
+        receiving the group's averaged result (a receive-only join; in a
+        singleton group a zero-weight round returns None — there is nothing
+        to receive).
+
         ``expected_size``: the collaboration's live peer count, if known —
         lets the leader assemble the moment the group is full instead of
         idling out the straggler window (matchmaking.form_group).
@@ -432,13 +450,16 @@ class DecentralizedAverager:
         if blob is None:
             tree, metadata = snapshot
 
-            def _serialize() -> bytes:
-                return pack_obj(
+            def _serialize() -> Tuple[bytes, bytes]:
+                data = pack_obj(
                     {
                         "metadata": pack_obj(metadata),
                         "tree": serialize_tree(tree, CompressionType.NONE),
                     }
                 )
+                # digest computed once at serialization time (the blob can be
+                # hundreds of MB; rehashing per request would be pure waste)
+                return data, hashlib.sha256(data).digest()
 
             # off the event loop (serializing the full model+optimizer state
             # can take seconds and must not stall live matchmaking/allreduce),
@@ -452,7 +473,14 @@ class DecentralizedAverager:
             with self._state_lock:
                 if self._shared_state is snapshot:  # not replaced meanwhile
                     self._shared_state_blob = blob
-        return {"state": blob}
+        data, digest = blob
+        if faults._active is not None:  # fault injection (testing/faults.py)
+            fault = faults.fire("averager.state_get", size=len(data))
+            if fault is not None and fault.action == "truncate":
+                # truncated download: the digest stays that of the FULL blob,
+                # so the receiver's checksum validation catches the cut
+                data = data[: int(len(data) * fault.fraction)]
+        return {"state": data, "checksum": digest}
 
     def publish_state_provider(
         self, expiration: float = 60.0, step: int = 0
@@ -495,20 +523,54 @@ class DecentralizedAverager:
 
         return self.dht.run_coroutine(_fetch)
 
-    def _live_state_providers(self):
-        entry = self.dht.get(f"{self.prefix}_state_providers", latest=True)
-        if entry is None or not hasattr(entry.value, "items"):
-            return []
-        candidates = []
-        for sk, v in entry.value.items():
+    def _provider_records(self, entry_items) -> List[Tuple[int, tuple]]:
+        """THE one parsing path for state-provider advertisements: skip our
+        own record, extract (step, endpoint), drop malformed entries.
+        ``_live_state_providers``, ``best_advertised_state_step`` and the
+        in-loop retry refresh all derive from it, so the views cannot drift
+        apart on a future record-format change (advisor r5). ``entry_items``
+        is an iterable of (subkey, unpacked advertisement dict)."""
+        records: List[Tuple[int, tuple]] = []
+        for sk, value in entry_items:
             if sk == getattr(self, "peer_id", None):
                 continue
             try:
-                candidates.append(
-                    (int(v.value.get("step", 0)), tuple(v.value["endpoint"]))
+                records.append(
+                    (int(value.get("step", 0)), tuple(value["endpoint"]))
                 )
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — malformed advertisement
                 continue
+        return records
+
+    def _advertised_state_records(self) -> List[Tuple[int, tuple]]:
+        """(step, endpoint) of every OTHER live provider, from the caller
+        thread (blocking DHT lookup)."""
+        entry = self.dht.get(f"{self.prefix}_state_providers", latest=True)
+        if entry is None or not hasattr(entry.value, "items"):
+            return []
+        return self._provider_records(
+            (sk, v.value) for sk, v in entry.value.items()
+        )
+
+    async def _advertised_state_records_async(
+        self, node
+    ) -> List[Tuple[int, tuple]]:
+        """Same view, from ON the DHT loop (retry attempts refresh the
+        provider list without a cross-thread round trip)."""
+        entry = await node.get(
+            f"{self.prefix}_state_providers".encode(), latest=True
+        )
+        items = []
+        if entry is not None and hasattr(entry.value, "items"):
+            for sk, v in entry.value.items():
+                try:
+                    items.append((sk, unpack_obj(v.value)))
+                except Exception:  # noqa: BLE001 — undecodable entry
+                    continue
+        return self._provider_records(items)
+
+    def _live_state_providers(self):
+        candidates = self._advertised_state_records()
         # newest snapshot first — a stale provider must not win the race
         candidates.sort(key=lambda c: -c[0])
         return [ep for _step, ep in candidates]
@@ -518,39 +580,67 @@ class DecentralizedAverager:
         DHT record — lets a resumed peer decide whether a download could
         possibly be newer than its checkpoint without pulling the full
         multi-hundred-MB state blob. None when nobody shares."""
-        entry = self.dht.get(f"{self.prefix}_state_providers", latest=True)
-        if entry is None or not hasattr(entry.value, "items"):
-            return None
-        steps = []
-        for sk, v in entry.value.items():
-            if sk == getattr(self, "peer_id", None):
-                continue
-            try:
-                steps.append(int(v.value.get("step", 0)))
-            except Exception:  # noqa: BLE001
-                continue
+        steps = [step for step, _ep in self._advertised_state_records()]
         return max(steps) if steps else None
 
     def load_state_from_peers(
-        self, timeout: float = 60.0
+        self,
+        timeout: float = 60.0,
+        retries: Optional[int] = None,
+        backoff: Optional[float] = None,
     ) -> Optional[Tuple[Dict[str, Any], Dict[str, np.ndarray]]]:
-        """Download (metadata, tree) from any live state provider."""
-        providers = self._live_state_providers()
+        """Download (metadata, tree) from a live state provider.
+
+        Peer-lifecycle robustness contract (``state_sync_retries`` /
+        ``state_sync_backoff``): the download is retried with exponential
+        backoff, each attempt re-reads the DHT provider list (a provider
+        that registered between attempts is picked up) and prefers providers
+        that have not already failed — so a dead or corrupt provider costs
+        one backoff, not the whole join. When EVERY known provider has
+        failed once, they are all retried anyway: a transient fault on the
+        only provider must not permanently fail the sync. Each received
+        snapshot is checksum-validated before deserialization, so a
+        truncated or corrupt download is detected and retried instead of
+        exploding mid-unpack (or silently adopting garbage)."""
+        retries = self.state_sync_retries if retries is None else retries
+        backoff = self.state_sync_backoff if backoff is None else backoff
 
         def _fetch(node):
             async def fetch():
-                for ep in providers:
-                    try:
-                        reply = await self.client.call(
-                            ep, "state.get", {}, timeout=timeout
-                        )
-                        obj = unpack_obj(reply["state"])
-                        return (
-                            unpack_obj(obj["metadata"]),
-                            deserialize_tree(obj["tree"]),
-                        )
-                    except Exception as e:  # noqa: BLE001 — try next provider
-                        logger.debug(f"state fetch from {ep} failed: {e!r}")
+                failed: set = set()
+                for attempt in range(retries + 1):
+                    if attempt:
+                        await asyncio.sleep(backoff * (2 ** (attempt - 1)))
+                    records = await self._advertised_state_records_async(node)
+                    records.sort(key=lambda c: -c[0])  # newest first
+                    providers = [ep for _step, ep in records]
+                    untried = [ep for ep in providers if ep not in failed]
+                    for ep in untried or providers:
+                        try:
+                            reply = await self.client.call(
+                                ep, "state.get", {}, timeout=timeout
+                            )
+                            blob = reply["state"]
+                            digest = reply.get("checksum")
+                            if (
+                                digest is not None
+                                and hashlib.sha256(blob).digest() != digest
+                            ):
+                                raise ValueError(
+                                    "state snapshot failed checksum "
+                                    "(truncated or corrupt download)"
+                                )
+                            obj = unpack_obj(blob)
+                            return (
+                                unpack_obj(obj["metadata"]),
+                                deserialize_tree(obj["tree"]),
+                            )
+                        except Exception as e:  # noqa: BLE001 — next provider
+                            failed.add(ep)
+                            logger.debug(
+                                f"state fetch from {ep} failed "
+                                f"(attempt {attempt + 1}/{retries + 1}): {e!r}"
+                            )
                 return None
 
             return fetch()

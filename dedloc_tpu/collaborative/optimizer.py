@@ -112,6 +112,18 @@ class CollaborativeOptimizer:
         # per-MICRO-batch mean grad at clip*(samples/micro-batch) before
         # averaging — tiny-batch peers inject high-per-sample-energy noise
         # otherwise (core/config.py CollaborativeOptimizerArguments)
+        ramp_rounds: int = 0,  # contribution ramp (0 = off): scale this
+        # peer's averaging weight from near-zero to its full sample count
+        # over its first ramp_rounds completed global steps — a fresh
+        # joiner receives the group's direction while barely perturbing it
+        # during basin formation (the enforced form of docs/fleet.md's
+        # "onboard onto a formed trunk" guidance)
+        health_gate_loss_ratio: float = 0.0,  # trunk-health gate (0 = off):
+        # while this peer's advertised loss exceeds ratio x the median
+        # advertised loss of the OTHER trainers, it defers mixing entirely
+        # (contributes weight 0, still receives the group average)
+        state_sync_retries: int = 2,  # bounded state-download retry with
+        state_sync_backoff: float = 0.5,  # exponential backoff (averager)
     ):
         assert not (client_mode and auxiliary), "an auxiliary peer must listen"
         self.tx = tx
@@ -124,6 +136,13 @@ class CollaborativeOptimizer:
         self.verbose = verbose
         self.statistics_expiration = statistics_expiration
         self.contrib_clip_per_sample = float(contrib_clip_per_sample)
+        self.ramp_rounds = int(ramp_rounds)
+        self.health_gate_loss_ratio = float(health_gate_loss_ratio)
+        # completed global steps since THIS optimizer joined — drives the
+        # contribution ramp. Deliberately reset on restart: a rejoining
+        # peer's params may have drifted while it was away, so it re-ramps.
+        self._rounds_since_join = 0
+        self._last_loss: Optional[float] = None
 
         self.averager = DecentralizedAverager(
             dht,
@@ -142,6 +161,8 @@ class CollaborativeOptimizer:
             authorizer=authorizer,
             authority_public_key=authority_public_key,
             relay=relay,
+            state_sync_retries=state_sync_retries,
+            state_sync_backoff=state_sync_backoff,
         )
         self.tracker = ProgressTracker(
             dht,
@@ -292,7 +313,82 @@ class CollaborativeOptimizer:
                 samples_per_second=self.performance_ema.samples_per_second,
                 time=get_dht_time(),
                 client_mode=self.client_mode,
+                loss=self._last_loss,
             )
+        )
+
+    # --------------------------------------------- contribution ramp / gate
+
+    def report_loss(self, loss: float) -> None:
+        """Advertise this peer's recent training loss on its next progress
+        report. Free for callers that already sync a loss scalar per global
+        step (both roles do, for logging); feeds the trunk-health gate —
+        without a reported loss the gate never engages for this peer."""
+        self._last_loss = float(loss)
+
+    @staticmethod
+    def ramp_fraction(rounds_since_join: int, ramp_rounds: int) -> float:
+        """Contribution-ramp schedule: the fraction of its full sample-count
+        weight a peer mixes in on its (rounds_since_join+1)-th round. Linear
+        from 1/(ramp_rounds+1) (near-zero for long ramps) to 1.0."""
+        if ramp_rounds <= 0:
+            return 1.0
+        return min(1.0, (rounds_since_join + 1) / (ramp_rounds + 1))
+
+    def mixing_weight_scale(self, collab) -> float:
+        """Scale applied to the sample-count weight this peer CONTRIBUTES to
+        the group average (it always receives the full group result):
+
+        - contribution ramp: fresh joiners mix at ``ramp_fraction`` of their
+          weight until ``ramp_rounds`` global steps have completed;
+        - trunk-health gate: a peer whose advertised loss exceeds
+          ``health_gate_loss_ratio`` x the median of the OTHER trainers'
+          advertised losses defers mixing entirely (weight 0) — its params
+          are suspect and must not steer the trunk; it keeps adopting the
+          group's averaged direction until its loss rejoins the pack. The
+          multiplicative ratio is only meaningful for POSITIVE losses
+          (MLM/SwAV); with a zero/negative median the comparison would
+          invert (every at-median peer would gate itself and the whole
+          collaboration could stall at total weight 0), so the gate
+          disengages there.
+        """
+        scale = self.ramp_fraction(self._rounds_since_join, self.ramp_rounds)
+        if (
+            self.health_gate_loss_ratio > 0
+            and self._last_loss is not None
+            and np.isfinite(collab.median_other_loss)
+            and collab.median_other_loss > 0
+            and self._last_loss
+            > self.health_gate_loss_ratio * collab.median_other_loss
+        ):
+            if self.verbose:
+                logger.warning(
+                    f"trunk-health gate: local loss {self._last_loss:.4f} > "
+                    f"{self.health_gate_loss_ratio:g} x median "
+                    f"{collab.median_other_loss:.4f} — deferring mixing "
+                    "(contributing zero weight this round)"
+                )
+            scale = 0.0
+        return scale
+
+    def _drop_gated_grads(self, state: TrainState, round_id: str):
+        """The trunk-health gate judged this round's gradients unsafe to MIX
+        — they are equally unsafe to apply locally (and a lagging partner
+        would then resync FROM our diverged post-apply state): drop them and
+        schedule a state resync instead of forcing progress."""
+        if self.verbose:
+            logger.warning(
+                f"{round_id}: health-gated and no group average received — "
+                "dropping local grads, will resync"
+            )
+        self._desynced = True
+        self._round_failures = 0
+        self.local_samples_accumulated = 0
+        return (
+            state,
+            zeros_like_grads(state.params),
+            jax.numpy.zeros([], jax.numpy.int32),
+            False,
         )
 
     def _global_step(self, state: TrainState, grad_acc, n_acc, collab):
@@ -325,11 +421,21 @@ class CollaborativeOptimizer:
             get_dht_time() - self._created_at
             >= self.tracker.metadata_expiration
         )
+        # contribution ramp + trunk-health gate: scale the weight this peer
+        # MIXES IN (it still receives the full group average) — a fresh or
+        # diverged joiner must not steer a formed trunk (docs/fleet.md)
+        weight_scale = self.mixing_weight_scale(collab)
         if (
             collab.num_peers_near_step <= 1
             and not self.client_mode
             and alone_grace
         ):
+            if weight_scale == 0.0:
+                # health-gated with no joinable group: the solo apply would
+                # commit the very gradients the gate judged unsafe — and
+                # the lagging partners would then resync FROM our diverged
+                # post-apply state
+                return self._drop_gated_grads(state, round_id)
             # alone AT THIS STEP: the group all-reduce is the identity, so
             # the gradients never leave the device — no device_get, no wire
             # codec, no matchmaking window. A peer that joins later (or
@@ -374,7 +480,8 @@ class CollaborativeOptimizer:
         self.performance_ema.pause()
         try:
             averaged, group_size = self.averager.step(
-                named, weight=float(self.local_samples_accumulated),
+                named,
+                weight=float(self.local_samples_accumulated) * weight_scale,
                 round_id=round_id,
                 # tracker's live peer count: full group => assemble the
                 # moment the last partner joins; the straggler window then
@@ -429,11 +536,16 @@ class CollaborativeOptimizer:
                 # schedule a state pull since our params will diverge
                 self._desynced = True
                 self._round_failures = 0
-                if self.verbose:
+                if self.verbose and weight_scale > 0.0:
                     logger.warning(
                         f"{round_id}: averaging failed repeatedly — applying "
                         "local grads, will resync"
                     )
+            if averaged is None and weight_scale == 0.0:
+                # no group average received this round (retry budget spent,
+                # or a near-step-only round that came back empty): a
+                # health-gated peer has nothing safe to apply locally
+                return self._drop_gated_grads(state, round_id)
             return self._apply_and_advance(state, mean_grads, collab, group_size)
         finally:
             self.performance_ema.resume()
@@ -463,6 +575,7 @@ class CollaborativeOptimizer:
             )
         self.seam_ms["apply"] = (time.perf_counter() - t0) * 1e3
         self.local_step = collab.optimizer_step + 1
+        self._rounds_since_join += 1  # advances the contribution ramp
         self.local_samples_accumulated = 0
         self._backup_and_share(new_state)
         self._report(synced=True)
@@ -583,9 +696,18 @@ class CollaborativeOptimizer:
             # full params+opt blob only to discard it wastes the provider's
             # uplink (advisor r5). The post-download check below still
             # guards the race where the advertisement was newer than the
-            # state actually served.
+            # state actually served. An advertisement can itself lag the
+            # duty-cycled backup by several applies — so when the TRACKER
+            # says the collaboration's counter is already past us, a
+            # tied-but-stale advertisement must not skip the download
+            # (advisor r5 low #2; the tracker view is equally KB-cheap).
             best = self.averager.best_advertised_state_step()
-            if best is not None and best <= self.local_step:
+            tracker_step = self.tracker.fetch_collaboration_state().optimizer_step
+            if (
+                best is not None
+                and best <= self.local_step
+                and tracker_step <= self.local_step
+            ):
                 logger.info(
                     f"best advertised peer state (step {best}) is not newer "
                     f"than local {self.local_step}; keeping local state"

@@ -10,6 +10,8 @@ the next step; the refresh period adapts between ``min_refresh_period`` and
 from __future__ import annotations
 
 import dataclasses
+import math
+import statistics
 from dataclasses import dataclass
 from typing import Optional
 
@@ -34,12 +36,17 @@ class LocalProgress:
     # instant the last TRAINER joins and the aux systematically loses the
     # race it is there to win
     aux: bool = False
+    # most recent training loss this peer advertises (None = not reported):
+    # the trunk-health gate compares a peer's own loss against the swarm
+    # median to decide whether its gradients are healthy enough to mix
+    loss: Optional[float] = None
 
     def pack(self) -> dict:
         return dataclasses.asdict(self)
 
     @classmethod
     def unpack(cls, d: dict) -> "LocalProgress":
+        loss = d.get("loss")
         return cls(
             step=int(d["step"]),
             samples_accumulated=int(d["samples_accumulated"]),
@@ -47,6 +54,7 @@ class LocalProgress:
             time=float(d["time"]),
             client_mode=bool(d.get("client_mode", False)),
             aux=bool(d.get("aux", False)),
+            loss=float(loss) if loss is not None else None,
         )
 
 
@@ -79,6 +87,10 @@ class CollaborationState:
     # overlaps the tail of accumulation (the reference's batch_size_lead,
     # albert/arguments.py CollaborativeOptimizerArguments)
     batch_size_lead: int = 0
+    # median advertised loss of the OTHER live trainers (nan when nobody
+    # advertises one): the reference point for the trunk-health gate — a
+    # peer whose own loss diverges far above this defers mixing
+    median_other_loss: float = float("nan")
 
     @property
     def ready_for_step(self) -> bool:
@@ -174,6 +186,21 @@ class ProgressTracker:
         # totals; they only size averaging groups (num_aux)
         records = [r for r in by_subkey.values() if not r.aux]
         num_aux = sum(r.aux for r in by_subkey.values())
+        # trunk-health reference: median advertised loss of the OTHER
+        # trainers (own record excluded — with two peers, including self
+        # would drag the median halfway toward the diverged joiner and
+        # soften the very gate it feeds)
+        other_losses = [
+            r.loss
+            for sk, r in by_subkey.items()
+            if not r.aux
+            and sk != self.peer_subkey
+            and r.loss is not None
+            and math.isfinite(r.loss)
+        ]
+        median_other_loss = (
+            statistics.median(other_losses) if other_losses else float("nan")
+        )
         max_step, total_samples, total_sps = 0, 0, 0.0
         num_peers = num_clients = num_at_step = num_near = 0
         if records:
@@ -220,4 +247,5 @@ class ProgressTracker:
             eta_next_step=eta,
             next_fetch_time=self._next_fetch,
             batch_size_lead=self.batch_size_lead,
+            median_other_loss=median_other_loss,
         )

@@ -173,6 +173,14 @@ class AveragerArguments:
     # listening averager doubles as a circuit relay, so give PUBLIC peers a
     # fixed port here and point client-mode volunteers' --dht.relay at it.
     listen_port: int = 0
+    # retrying state sync (peer-lifecycle robustness): a state download is
+    # retried up to state_sync_retries times with exponential backoff
+    # starting at state_sync_backoff seconds; each attempt refreshes the
+    # provider list and prefers providers that have not failed yet, and
+    # every snapshot is checksum-validated — so a dead or corrupt provider
+    # costs one backoff instead of a failed join
+    state_sync_retries: int = 2
+    state_sync_backoff: float = 0.5
 
 
 @dataclass
@@ -200,6 +208,25 @@ class CollaborativeOptimizerArguments:
     # healthy B=16 peer (1.47 at init, 0.31 trained) and suppresses the
     # B=2 outlier 14x. SwAV runs default it on (roles/swav.py).
     contrib_clip_per_sample: float = 0.0
+    # contribution ramp (0 = off): a joining peer's averaging weight scales
+    # linearly from 1/(ramp_rounds+1) of its sample count to its full
+    # sample count over its first ramp_rounds completed global steps. The
+    # joiner RECEIVES the group's averaged direction from round one but
+    # barely perturbs it while its params settle into the group's basin —
+    # the enforced form of "onboard volunteers onto a formed trunk"
+    # (docs/fleet.md; measured: unramped from-scratch SwAV fleets probe
+    # 13.0% vs the 22.4% solo bar). SwAV runs default it on.
+    ramp_rounds: int = 0
+    # trunk-health gate (0 = off): while this peer's advertised loss
+    # exceeds ratio x the median advertised loss of the OTHER trainers, it
+    # defers mixing entirely — contributing zero weight but still adopting
+    # the group average — until its loss rejoins the pack. Engages only
+    # for peers that report a loss (roles do, once per global step), and
+    # only while the swarm median is POSITIVE (a multiplicative ratio
+    # inverts on zero/negative losses). A gated peer never applies its
+    # suspect gradients locally either: with no group average received it
+    # drops them and resyncs state.
+    health_gate_loss_ratio: float = 0.0
 
 
 @dataclass
@@ -354,6 +381,12 @@ class SwAVCollaborationArguments:
             # max_grad_norm already bounds that path and the converged
             # recipe predates the knob)
             contrib_clip_per_sample=2.0,
+            # SwAV also defaults the contribution ramp ON: basin formation
+            # is exactly where multi-peer gradient noise cost ~40% of the
+            # probe (13.0% vs 22.4% solo, BASELINE.md round 5) — a fresh
+            # joiner spends its first 10 rounds adopting the trunk's
+            # direction before mixing at full weight
+            ramp_rounds=10,
         )
     )
     training: SwAVTrainingArguments = field(

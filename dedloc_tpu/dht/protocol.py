@@ -24,6 +24,7 @@ import struct
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 from dedloc_tpu.core.serialization import pack_obj, unpack_obj
+from dedloc_tpu.testing import faults
 from dedloc_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -168,6 +169,18 @@ class RPCServer:
     async def _dispatch(self, peer, msg, writer) -> None:
         req_id = msg.get("id")
         method = msg.get("method")
+        if faults._active is not None:  # fault injection (testing/faults.py)
+            fault = faults.fire(
+                "rpc.server.dispatch", method=method, peer=peer, server=self,
+                port=self.port,
+            )
+            if fault is not None:
+                try:
+                    await faults.apply_transport_fault(fault, f"rpc {method}")
+                except (ConnectionResetError, OSError):
+                    # process-death semantics: reset the connection, no reply
+                    writer.close()
+                    return
         handler = self._handlers.get(method)
         try:
             if handler is None:
@@ -316,6 +329,13 @@ class RPCClient:
         reversal / hole punch, dht/nat.py), and finally a ``relay.call``
         wrapped to the public peer hosting the registration (circuit
         relay)."""
+        if faults._active is not None:  # fault injection (testing/faults.py)
+            fault = faults.fire(
+                "rpc.client.call", method=method, endpoint=endpoint,
+                client=self,
+            )
+            if fault is not None:
+                await faults.apply_transport_fault(fault, f"rpc {method}")
         relayed = parse_relay_endpoint(endpoint)
         if relayed is not None:
             relay, peer_hex = relayed

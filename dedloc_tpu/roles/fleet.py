@@ -19,7 +19,12 @@ in-framework, scriptable harness instead of cloud-specific operations:
   state from peers, exercising the elasticity path end-to-end.
 
 This doubles as the fault-injection harness SURVEY.md §4 calls the biggest
-testing gap: deterministic preemption under a live collaboration.
+testing gap: deterministic preemption under a live collaboration. A
+``testing.faults.FaultSchedule`` makes the churn fully scripted: victim
+selection draws from the schedule's seeded RNG (one seed replays the whole
+scenario) and an injected ``fleet.preempt`` fault with a ``target`` names
+the exact trainer to kill — so "kill trainer1 on the third churn tick" is a
+reproducible test, not a soak.
 """
 from __future__ import annotations
 
@@ -33,6 +38,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from dedloc_tpu.core.config import parse_config
+from dedloc_tpu.testing.faults import FaultSchedule
 from dedloc_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -69,14 +75,22 @@ class LocalFleet:
     """Process-supervisor for one local collaboration."""
 
     def __init__(self, args: FleetArguments, extra_trainer_flags:
-                 Optional[List[str]] = None):
+                 Optional[List[str]] = None,
+                 fault_schedule: Optional[FaultSchedule] = None):
         self.args = args
         self.extra_trainer_flags = list(extra_trainer_flags or [])
         self.root_port = _free_port()
         self.root_addr = f"127.0.0.1:{self.root_port}"
         self.procs: Dict[str, subprocess.Popen] = {}
         self.events: List[Dict] = []  # spawn/preempt/respawn log
-        self._rng = random.Random(args.seed)
+        # deterministic churn: with a FaultSchedule attached, victim choice
+        # draws from ITS seeded RNG (one seed replays the scenario) and
+        # injected "fleet.preempt" faults can script exact victims
+        self.faults = fault_schedule
+        self._rng = (
+            fault_schedule.rng if fault_schedule is not None
+            else random.Random(args.seed)
+        )
         self._harness_killed: set = set()  # pids WE killed (vs external death)
         self._crash_counts: Dict[str, int] = {}
         self.max_crash_respawns = 5  # per-peer cap on crash-loop restarts
@@ -173,7 +187,13 @@ class LocalFleet:
         ]
         if not alive:
             return None
-        victim = self._rng.choice(alive)
+        victim = None
+        if self.faults is not None:
+            fault = self.faults.fire("fleet.preempt", alive=alive)
+            if fault is not None and fault.target in alive:
+                victim = fault.target  # scripted kill
+        if victim is None:
+            victim = self._rng.choice(alive)
         self._harness_killed.add(self.procs[victim].pid)
         self.procs[victim].kill()
         self.procs[victim].wait()

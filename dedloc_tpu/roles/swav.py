@@ -139,6 +139,10 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
         metadata_expiration=args.averager.metadata_expiration,
         statistics_expiration=args.optimizer.statistics_expiration,
         contrib_clip_per_sample=args.optimizer.contrib_clip_per_sample,
+        ramp_rounds=args.optimizer.ramp_rounds,
+        health_gate_loss_ratio=args.optimizer.health_gate_loss_ratio,
+        state_sync_retries=args.averager.state_sync_retries,
+        state_sync_backoff=args.averager.state_sync_backoff,
         client_mode=args.dht.client_mode,
         relay=args.dht.relay or None,
         listen_port=args.averager.listen_port,
@@ -245,6 +249,10 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
         state, local["grad_acc"], local["n_acc"], _stepped = opt.step(
             state, local["grad_acc"], local["n_acc"], samples
         )
+        if _stepped:
+            # advertise the loss for the trunk-health gate — one host sync
+            # per GLOBAL step, the same cadence the ALBERT trainer pays
+            opt.report_loss(float(loss))
         return state, {"loss": loss, "global_step": opt.local_step}
 
     def _put_crops(crops):

@@ -239,6 +239,10 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         metadata_expiration=args.averager.metadata_expiration,
         statistics_expiration=args.optimizer.statistics_expiration,
         contrib_clip_per_sample=args.optimizer.contrib_clip_per_sample,
+        ramp_rounds=args.optimizer.ramp_rounds,
+        health_gate_loss_ratio=args.optimizer.health_gate_loss_ratio,
+        state_sync_retries=args.averager.state_sync_retries,
+        state_sync_backoff=args.averager.state_sync_backoff,
         min_refresh_period=args.averager.min_refresh_period,
         max_refresh_period=args.averager.max_refresh_period,
         default_refresh_period=args.averager.default_refresh_period,
@@ -369,6 +373,9 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
             if stepped:
                 loss_sum = float(loss_sum_dev)  # the one sync per global step
                 loss_sum_dev = jnp.zeros([])
+                # advertise the loss for the trunk-health gate — free here,
+                # the scalar is already on the host
+                opt.report_loss(loss_sum / max(mini_steps, 1))
                 sps = float(opt.performance_ema.samples_per_second)
                 publish_metrics(
                     dht,

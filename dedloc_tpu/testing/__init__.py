@@ -1,0 +1,6 @@
+"""Deterministic testing utilities: fault injection + fake clock."""
+from dedloc_tpu.testing.faults import (  # noqa: F401
+    FakeClock,
+    Fault,
+    FaultSchedule,
+)

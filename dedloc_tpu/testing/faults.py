@@ -1,0 +1,206 @@
+"""Deterministic fault injection + fake clock for peer-lifecycle tests.
+
+Multi-peer failure paths (leader death mid-matchmaking, truncated state
+downloads, stragglers past SLA, join-during-round) used to be exercised only
+by real-time churn harnesses that flake on a loaded host. This module makes
+fault behavior a first-class, deterministically-testable mechanism:
+
+- ``FaultSchedule``: a seeded schedule of named fault points. Tests program
+  faults (``inject``); instrumented code consults the schedule (``fire``)
+  at well-known points and applies the returned action. The schedule logs
+  every observation and firing so tests can assert exactly what happened.
+- ``FakeClock``: scenario time. All matchmaking windows, straggler SLAs and
+  DHT record expirations are deadlines on ``get_dht_time()``, so advancing
+  the shared offset (``set_dht_time_offset``) expires them instantly —
+  scenarios that used to be wall-clock soaks become reproducible unit tests
+  that never idle out a real window.
+
+Fault points currently wired:
+
+| point                  | where                                   | context keys |
+|------------------------|-----------------------------------------|--------------|
+| ``rpc.client.call``    | ``RPCClient.call`` before the frame     | method, endpoint, client |
+| ``rpc.server.dispatch``| ``RPCServer._dispatch`` before handler  | method, peer, server, port |
+| ``averager.state_get`` | state-snapshot reply (blob mutation)    | size |
+| ``fleet.preempt``      | ``LocalFleet`` victim selection         | alive |
+
+Actions: ``drop`` (reset the connection / raise ConnectionResetError —
+process-death semantics: a killed peer's OS resets its sockets), ``delay``
+(hold the RPC for ``delay`` seconds), ``error`` (raise an OSError),
+``truncate`` (cut a state blob to ``fraction`` of its bytes, leaving the
+checksum stale), ``kill`` (run ``callback`` — e.g. stop a server — then
+reset the connection).
+
+The hooks are zero-cost when no schedule is installed: instrumented code
+checks the module-level ``_active`` attribute and returns immediately.
+Production never installs a schedule.
+"""
+from __future__ import annotations
+
+import asyncio
+import inspect
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from dedloc_tpu.core.timeutils import set_dht_time_offset
+
+
+@dataclass
+class Fault:
+    """One programmed fault. ``times`` bounds how often it fires (-1 =
+    unlimited); ``match`` filters on the fire-site context dict; ``target``
+    names a specific victim (fleet preemption); ``callback`` runs for
+    ``kill`` actions (sync or async)."""
+
+    point: str
+    action: str  # drop | delay | error | truncate | kill
+    times: int = 1
+    match: Optional[Callable[[Dict[str, Any]], bool]] = None
+    delay: float = 0.0
+    fraction: float = 0.5
+    target: Optional[str] = None
+    callback: Optional[Callable[..., Any]] = None
+
+
+class FaultSchedule:
+    """Seeded schedule of named fault points.
+
+    Usage::
+
+        with FaultSchedule(seed=0) as schedule:
+            schedule.inject("rpc.server.dispatch", "drop",
+                            match=lambda ctx: ctx["method"] == "mm.join")
+            ... run the scenario ...
+            assert schedule.fired  # the fault actually triggered
+
+    ``rng`` is the schedule's seeded randomness — harnesses that need a
+    random choice (e.g. fleet victim selection) draw from it so the whole
+    scenario replays from one seed.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.faults: List[Fault] = []
+        # (point, context) logs: every consultation, and every actual firing
+        self.observed: List[Tuple[str, Dict[str, Any]]] = []
+        self.fired: List[Tuple[str, Dict[str, Any]]] = []
+
+    def inject(
+        self,
+        point: str,
+        action: str,
+        *,
+        times: int = 1,
+        match: Optional[Callable[[Dict[str, Any]], bool]] = None,
+        delay: float = 0.0,
+        fraction: float = 0.5,
+        target: Optional[str] = None,
+        callback: Optional[Callable[..., Any]] = None,
+    ) -> Fault:
+        fault = Fault(point, action, times, match, delay, fraction, target,
+                      callback)
+        self.faults.append(fault)
+        return fault
+
+    def fire(self, point: str, **context: Any) -> Optional[Fault]:
+        """Called by instrumented code at a fault point; returns the fault
+        to apply (consuming one of its ``times``), or None."""
+        self.observed.append((point, context))
+        for fault in self.faults:
+            if fault.point != point or fault.times == 0:
+                continue
+            if fault.match is not None and not fault.match(context):
+                continue
+            if fault.target is not None:
+                # a targeted fault only fires when its victim is actually in
+                # the offered candidate set — otherwise it stays ARMED (not
+                # consumed) so "kill trainer1" still means trainer1 on a
+                # later tick, never a silent random victim
+                candidates = context.get("alive")
+                if candidates is not None and fault.target not in candidates:
+                    continue
+            if fault.times > 0:
+                fault.times -= 1
+            self.fired.append((point, context))
+            return fault
+        return None
+
+    # ------------------------------------------------------- install/uninstall
+
+    def install(self) -> "FaultSchedule":
+        global _active
+        _active = self
+        return self
+
+    def uninstall(self) -> None:
+        global _active
+        if _active is self:
+            _active = None
+
+    def __enter__(self) -> "FaultSchedule":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+# the installed schedule; instrumented code checks this attribute directly
+# (``faults._active is not None``) so the production fast path is one load
+_active: Optional[FaultSchedule] = None
+
+
+def active() -> Optional[FaultSchedule]:
+    return _active
+
+
+def fire(point: str, **context: Any) -> Optional[Fault]:
+    """Consult the installed schedule (None when fault injection is off)."""
+    return _active.fire(point, **context) if _active is not None else None
+
+
+async def apply_transport_fault(fault: Fault, what: str) -> None:
+    """Apply a client/server transport fault inside the event loop. ``drop``
+    and ``kill`` raise (the caller sees a dead peer); ``delay`` returns
+    after sleeping; ``error`` raises an OSError."""
+    if fault.action == "delay":
+        await asyncio.sleep(fault.delay)
+        return
+    if fault.action == "kill" and fault.callback is not None:
+        result = fault.callback()
+        if inspect.isawaitable(result):
+            await result
+    if fault.action in ("drop", "kill"):
+        raise ConnectionResetError(f"fault injected: dropped {what}")
+    if fault.action == "error":
+        raise OSError(f"fault injected: error on {what}")
+
+
+class FakeClock:
+    """Deterministic scenario clock over ``set_dht_time_offset``.
+
+    All DHT expirations, matchmaking windows and straggler deadlines are
+    absolute timestamps on ``get_dht_time()``; with a FakeClock installed
+    they only expire when the test calls ``advance`` — a loaded host can
+    never spuriously time a scenario out, and a test never sleeps real
+    time to wait a window out.
+
+    The offset is process-global (every in-process peer shares the DHT
+    clock, as NTP-synchronized real peers would), and restored to zero on
+    exit.
+    """
+
+    def __init__(self, start: float = 0.0):
+        self.offset = float(start)
+
+    def __enter__(self) -> "FakeClock":
+        set_dht_time_offset(self.offset)
+        return self
+
+    def advance(self, seconds: float) -> None:
+        self.offset += float(seconds)
+        set_dht_time_offset(self.offset)
+
+    def __exit__(self, *exc) -> None:
+        set_dht_time_offset(0.0)

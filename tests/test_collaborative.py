@@ -229,6 +229,8 @@ def test_resumed_peer_not_demoted_by_fresh_racer():
         tx, first_dht, "race", **_opt_kwargs(target_batch_size=32,
                                              averaging_expiration=0.3)
     )
+    second_dht = None
+    opt2 = opt3 = None
     try:
         params = {"w": jnp.array([[0.5], [0.5]])}
         state = TrainState.create(params, tx)
@@ -245,6 +247,14 @@ def test_resumed_peer_not_demoted_by_fresh_racer():
                 state, grad_acc, n_acc, samples=16
             )
             steps += stepped
+        # deflake (advisor r5): opt1's step-2 snapshot is published by an
+        # ASYNCHRONOUS backup thread (which may even have been duty-cycle
+        # skipped) — republish deterministically, then wait below until the
+        # step-2 advertisement is actually visible before opt3 loads, so it
+        # can never adopt the step-1 snapshot and fail the local_step check
+        opt1._join_backup()
+        opt1.seed_state_sharing(state)
+        opt1._join_backup()
 
         second_dht = DHT(start=True, listen_host="127.0.0.1",
                          initial_peers=[first_dht.get_visible_address()])
@@ -260,6 +270,12 @@ def test_resumed_peer_not_demoted_by_fresh_racer():
 
         # cold start keeps the old semantics: adopt even a same-step provider
         opt3 = CollaborativeOptimizer(tx, second_dht, "race", **_opt_kwargs())
+        deadline = time.time() + 15
+        while (
+            (opt3.averager.best_advertised_state_step() or 0) < opt1.local_step
+            and time.time() < deadline
+        ):
+            time.sleep(0.05)
         fresh = TrainState.create({"w": jnp.array([[0.0], [0.0]])}, tx)
         adopted = opt3.load_state_from_peers(fresh)
         np.testing.assert_allclose(
@@ -268,10 +284,14 @@ def test_resumed_peer_not_demoted_by_fresh_racer():
             atol=1e-6,
         )
         assert opt3.local_step == opt1.local_step
-        opt2.shutdown()
-        opt3.shutdown()
-        second_dht.shutdown()
     finally:
+        # inside finally (advisor r5): an assertion above must not leak the
+        # second swarm's DHT threads
+        for opt in (opt2, opt3):
+            if opt is not None:
+                opt.shutdown()
+        if second_dht is not None:
+            second_dht.shutdown()
         opt1.shutdown()
         first_dht.shutdown()
 
