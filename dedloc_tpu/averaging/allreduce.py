@@ -46,6 +46,7 @@ from dedloc_tpu.core.serialization import (
 )
 from dedloc_tpu.averaging.partition import partition_weighted
 from dedloc_tpu.dht.protocol import Endpoint, RPCClient, RPCError, RPCServer
+from dedloc_tpu.telemetry import registry as telemetry
 from dedloc_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -80,8 +81,10 @@ class GroupAllReduce:
         compression: CompressionType = CompressionType.FLOAT16,
         timeout: float = 30.0,
         straggler_timeout: float = 5.0,
+        telemetry_registry=None,  # per-peer scope (telemetry/registry.py)
     ):
         self.client = client
+        self.telemetry = telemetry_registry
         self.compression = compression
         self.timeout = timeout
         self.straggler_timeout = straggler_timeout
@@ -160,22 +163,41 @@ class GroupAllReduce:
             my_state.expected_senders = set(senders)
             my_state.maybe_complete()
 
+        tele = telemetry.resolve(self.telemetry)
+        span_cm = (
+            tele.span("allreduce.round", round_id=round_id, group_size=n)
+            if tele is not None
+            else telemetry.null_span()
+        )
         try:
-            return await asyncio.wait_for(
-                self._run_inner(
-                    round_id, my_index, vector, weight, endpoints, spans,
-                    my_state, senders,
-                ),
-                timeout=self.timeout,
-            )
-        except (
-            asyncio.TimeoutError, ConnectionError, OSError, RPCError, ValueError,
-        ) as e:
-            # RPCError covers remote-side failures (a host whose handler timed
-            # out or crashed replies ok=False); ValueError covers corrupt
-            # frames (checksum/shape mismatch) — a failed round must cost one
-            # round, not the training process
-            raise AllreduceFailed(f"round {round_id}: {e!r}") from e
+            with span_cm as ctx:
+                try:
+                    result = await asyncio.wait_for(
+                        self._run_inner(
+                            round_id, my_index, vector, weight, endpoints,
+                            spans, my_state, senders,
+                        ),
+                        timeout=self.timeout,
+                    )
+                except (
+                    asyncio.TimeoutError, ConnectionError, OSError, RPCError,
+                    ValueError,
+                ) as e:
+                    # RPCError covers remote-side failures (a host whose
+                    # handler timed out or crashed replies ok=False);
+                    # ValueError covers corrupt frames (checksum/shape
+                    # mismatch) — a failed round must cost one round, not the
+                    # training process
+                    if tele is not None:
+                        tele.counter("allreduce.failures").inc()
+                        ctx["ok"] = False
+                        ctx["error"] = type(e).__name__
+                    raise AllreduceFailed(f"round {round_id}: {e!r}") from e
+                if tele is not None:
+                    tele.counter("allreduce.rounds").inc()
+                    ctx["ok"] = True
+                    ctx["bytes"] = int(vector.nbytes)
+                return result
         finally:
             # deferred cleanup: slower members may still pull our reduced span
             asyncio.get_running_loop().call_later(
@@ -187,6 +209,7 @@ class GroupAllReduce:
         senders,
     ) -> np.ndarray:
         n = len(endpoints)
+        tele = telemetry.resolve(self.telemetry)
         # 1) scatter: send my slice of each host's span (zero-weight marker
         # when I have no data, so hosts never wait on an aux peer)
         sends = []
@@ -211,6 +234,10 @@ class GroupAllReduce:
                     else None
                 ),
             }
+            if tele is not None and weight > 0:
+                # logical tensor bytes moved (pre-compression float32); the
+                # wire view lives in the frame-level net.bytes_* counters
+                tele.counter("allreduce.bytes_sent").inc((hi - lo) * 4)
             sends.append(
                 self.client.call(
                     endpoints[j], "avg.part", payload, timeout=self.timeout
@@ -231,6 +258,12 @@ class GroupAllReduce:
                 logger.warning(
                     f"{round_id}: proceeding without stragglers {sorted(missing)}"
                 )
+                if tele is not None:
+                    tele.counter("allreduce.stragglers").inc(len(missing))
+                    tele.event(
+                        "allreduce.stragglers", round_id=round_id,
+                        missing=sorted(missing),
+                    )
             total_w = sum(w for p, w in my_state.parts.values() if p is not None)
             lo, hi = spans[my_index]
             if total_w > 0:
@@ -257,6 +290,8 @@ class GroupAllReduce:
                 {"round_id": round_id},
                 timeout=self.timeout,
             )
+            if tele is not None:
+                tele.counter("allreduce.bytes_received").inc((hi - lo) * 4)
             return deserialize_array(reply["data"]).astype(np.float32)
 
         pieces = await asyncio.gather(*(fetch(j) for j in range(n)))

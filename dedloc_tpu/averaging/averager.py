@@ -35,6 +35,7 @@ from dedloc_tpu.core.serialization import (
 from dedloc_tpu.core.timeutils import get_dht_time
 from dedloc_tpu.dht.dht import DHT
 from dedloc_tpu.dht.protocol import RPCClient, RPCError, RPCServer
+from dedloc_tpu.telemetry import registry as telemetry
 from dedloc_tpu.testing import faults
 from dedloc_tpu.utils.logging import get_logger
 
@@ -83,6 +84,11 @@ class DecentralizedAverager:
         # load_state_from_peers)
         state_sync_retries: int = 2,
         state_sync_backoff: float = 0.5,
+        # per-peer telemetry scope (telemetry/registry.py): in-process
+        # multi-peer tests pass one registry per simulated peer; production
+        # (one peer per process) leaves None and the process-global
+        # registry — if installed — is used at each instrumented site
+        telemetry_registry=None,
     ):
         if relay and not client_mode:
             # a listening peer IS a relay; accepting (and dropping) the flag
@@ -108,6 +114,7 @@ class DecentralizedAverager:
         self.relay_keepalive_period = relay_keepalive_period
         self.state_sync_retries = int(state_sync_retries)
         self.state_sync_backoff = float(state_sync_backoff)
+        self.telemetry = telemetry_registry
         self._listen = (listen_host, listen_port)
         self._advertised_host = advertised_host or "127.0.0.1"
         self._shared_state: Optional[Tuple[Dict[str, np.ndarray], Dict[str, Any]]] = None
@@ -126,9 +133,14 @@ class DecentralizedAverager:
             async def setup():
                 from dedloc_tpu.dht.protocol import RelayService
 
-                self.client = RPCClient(request_timeout=averaging_timeout)
+                self.client = RPCClient(
+                    request_timeout=averaging_timeout,
+                    telemetry_registry=self.telemetry,
+                )
                 if not client_mode:
-                    self.server = RPCServer(*self._listen)
+                    self.server = RPCServer(
+                        *self._listen, telemetry_registry=self.telemetry
+                    )
                     self.server.register("state.get", self._rpc_state_get)
                     await self.server.start()
                     self.endpoint = (self._advertised_host, self.server.port)
@@ -317,6 +329,7 @@ class DecentralizedAverager:
                     compression=self.compression,
                     timeout=averaging_timeout,
                     straggler_timeout=averaging_expiration,
+                    telemetry_registry=self.telemetry,
                 )
                 self.matchmaking = Matchmaking(
                     node,
@@ -331,6 +344,7 @@ class DecentralizedAverager:
                     authorizer=authorizer,
                     authority_public_key=authority_public_key,
                     aux=auxiliary,
+                    telemetry_registry=self.telemetry,
                 )
 
             return setup()
@@ -382,6 +396,26 @@ class DecentralizedAverager:
         return fut if return_future else fut.result()
 
     async def _step_async(
+        self, tree: Dict[str, np.ndarray], weight: float, round_id: str,
+        expected_size: Optional[int] = None,
+        window: Optional[float] = None,
+    ) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
+        tele = telemetry.resolve(self.telemetry)
+        if tele is None:  # telemetry off: the bare path, zero overhead
+            return await self._step_inner(
+                tree, weight, round_id, expected_size, window
+            )
+        # one span per averaging round: matchmaking + allreduce + weight,
+        # the unit the operator asks "why was step N slow" about
+        with tele.span("avg.round", round_id=round_id, weight=weight) as ctx:
+            averaged, group_size = await self._step_inner(
+                tree, weight, round_id, expected_size, window
+            )
+            ctx["ok"] = averaged is not None
+            ctx["group_size"] = group_size
+            return averaged, group_size
+
+    async def _step_inner(
         self, tree: Dict[str, np.ndarray], weight: float, round_id: str,
         expected_size: Optional[int] = None,
         window: Optional[float] = None,
@@ -474,12 +508,24 @@ class DecentralizedAverager:
                 if self._shared_state is snapshot:  # not replaced meanwhile
                     self._shared_state_blob = blob
         data, digest = blob
+        tele = telemetry.resolve(self.telemetry)
+        if tele is not None:
+            tele.counter("state.served").inc()
+            tele.counter("state.served_bytes").inc(len(data))
         if faults._active is not None:  # fault injection (testing/faults.py)
             fault = faults.fire("averager.state_get", size=len(data))
             if fault is not None and fault.action == "truncate":
                 # truncated download: the digest stays that of the FULL blob,
                 # so the receiver's checksum validation catches the cut
                 data = data[: int(len(data) * fault.fraction)]
+                if tele is not None:
+                    # attribute the APPLIED fault to the SERVING peer — the
+                    # downloader sees only a checksum failure
+                    tele.counter("faults.applied").inc()
+                    tele.event(
+                        "fault.applied", point="averager.state_get",
+                        action="truncate", fraction=fault.fraction,
+                    )
         return {"state": data, "checksum": digest}
 
     def publish_state_provider(
@@ -607,16 +653,28 @@ class DecentralizedAverager:
 
         def _fetch(node):
             async def fetch():
+                tele = telemetry.resolve(self.telemetry)
                 failed: set = set()
                 for attempt in range(retries + 1):
                     if attempt:
-                        await asyncio.sleep(backoff * (2 ** (attempt - 1)))
+                        delay = backoff * (2 ** (attempt - 1))
+                        if tele is not None:
+                            # retry/backoff trace: the coordinator's retry-
+                            # rate view is built from these counters
+                            tele.counter("state_sync.retries").inc()
+                            tele.event(
+                                "state_sync.retry", attempt=attempt,
+                                backoff_s=delay,
+                            )
+                        await asyncio.sleep(delay)
                     records = await self._advertised_state_records_async(node)
                     records.sort(key=lambda c: -c[0])  # newest first
                     providers = [ep for _step, ep in records]
                     untried = [ep for ep in providers if ep not in failed]
                     for ep in untried or providers:
                         try:
+                            if tele is not None:
+                                tele.counter("state_sync.attempts").inc()
                             reply = await self.client.call(
                                 ep, "state.get", {}, timeout=timeout
                             )
@@ -626,17 +684,39 @@ class DecentralizedAverager:
                                 digest is not None
                                 and hashlib.sha256(blob).digest() != digest
                             ):
+                                if tele is not None:
+                                    tele.counter(
+                                        "state_sync.checksum_failures"
+                                    ).inc()
+                                    tele.event(
+                                        "state_sync.checksum_failure",
+                                        provider=ep, attempt=attempt + 1,
+                                        bytes=len(blob),
+                                    )
                                 raise ValueError(
                                     "state snapshot failed checksum "
                                     "(truncated or corrupt download)"
                                 )
                             obj = unpack_obj(blob)
+                            if tele is not None:
+                                tele.counter("state_sync.ok").inc()
+                                tele.event(
+                                    "state_sync.ok", provider=ep,
+                                    bytes=len(blob), attempt=attempt + 1,
+                                )
                             return (
                                 unpack_obj(obj["metadata"]),
                                 deserialize_tree(obj["tree"]),
                             )
                         except Exception as e:  # noqa: BLE001 — next provider
                             failed.add(ep)
+                            if tele is not None:
+                                tele.counter("state_sync.failures").inc()
+                                tele.event(
+                                    "state_sync.failed", provider=ep,
+                                    attempt=attempt + 1,
+                                    error=type(e).__name__,
+                                )
                             logger.debug(
                                 f"state fetch from {ep} failed "
                                 f"(attempt {attempt + 1}/{retries + 1}): {e!r}"

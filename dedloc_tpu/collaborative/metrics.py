@@ -21,6 +21,10 @@ from dedloc_tpu.dht.validation import (
     RSASignatureValidator,
     SchemaValidator,
 )
+from dedloc_tpu.telemetry import registry as telemetry
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
 
 
 class LocalMetrics(BaseModel):
@@ -41,6 +45,11 @@ class LocalMetrics(BaseModel):
     data_wait_ms: Optional[StrictFloat] = None  # host input-pipeline stall
     allreduce_ms: Optional[StrictFloat] = None  # averaging round (stepped only)
     hbm_bytes: Optional[StrictInt] = None  # device bytes_in_use
+    # swarm-telemetry snapshot (telemetry/registry.py Telemetry.snapshot):
+    # flat {counter/gauge/histogram name: value} — the coordinator folds
+    # these into its swarm-health record (telemetry/health.py). Optional so
+    # peers with telemetry disabled (and pre-telemetry records) validate.
+    telemetry: Optional[Dict[str, float]] = None
     # filled by fetch_metrics from the signed DHT subkey, never by peers:
     # a stable fingerprint so the coordinator can attribute stragglers
     peer: Optional[str] = None
@@ -81,9 +90,18 @@ def publish_metrics(
     )
 
 
+# peers whose malformed metrics records were already reported: the drop is
+# logged at WARNING once per peer (a wedged peer republishes every few
+# seconds — repeating the warning each aggregation tick would bury the log),
+# counted through the telemetry registry every time
+_malformed_warned: set = set()
+
+
 def fetch_metrics(dht: DHT, prefix: str) -> List[LocalMetrics]:
     """All currently-live peer metrics (coordinator view,
-    run_first_peer.py:177-187)."""
+    run_first_peer.py:177-187). Malformed records are dropped, counted
+    (``metrics.malformed_records``) and reported once per peer — a peer
+    publishing garbage must be visible, not silently invisible."""
     entry = dht.get(f"{prefix}_metrics", latest=True)
     out: List[LocalMetrics] = []
     if entry is None or not hasattr(entry.value, "items"):
@@ -91,18 +109,27 @@ def fetch_metrics(dht: DHT, prefix: str) -> List[LocalMetrics]:
     import hashlib
 
     for subkey, v in entry.value.items():
+        raw = subkey if isinstance(subkey, bytes) else str(subkey).encode()
+        peer = hashlib.sha1(raw).hexdigest()[:12]
         try:
             payload = v.value
             if isinstance(payload, (bytes, bytearray)):
                 payload = unpack_obj(payload)
             record = LocalMetrics.model_validate(payload)
-            raw = subkey if isinstance(subkey, bytes) else str(subkey).encode()
-            out.append(
-                record.model_copy(
-                    update={"peer": hashlib.sha1(raw).hexdigest()[:12]}
+            out.append(record.model_copy(update={"peer": peer}))
+        except Exception as e:  # noqa: BLE001 — skip malformed peer records
+            telemetry.inc("metrics.malformed_records")
+            if peer not in _malformed_warned:
+                if len(_malformed_warned) >= 4096:
+                    # bound the memory on a churning fleet; clearing also
+                    # re-arms the warning for a peer that regressed long
+                    # after it was first reported
+                    _malformed_warned.clear()
+                _malformed_warned.add(peer)
+                logger.warning(
+                    f"dropping malformed metrics record from peer {peer}: "
+                    f"{e!r} (reported once; further drops are only counted)"
                 )
-            )
-        except Exception:  # noqa: BLE001 — skip malformed peer records
             continue
     return out
 

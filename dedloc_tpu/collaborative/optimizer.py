@@ -36,6 +36,7 @@ from dedloc_tpu.collaborative.progress import (
 )
 from dedloc_tpu.core.timeutils import PerformanceEMA, get_dht_time
 from dedloc_tpu.dht.dht import DHT
+from dedloc_tpu.telemetry import registry as telemetry
 from dedloc_tpu.parallel.train_step import (
     TrainState,
     make_apply_step,
@@ -124,6 +125,9 @@ class CollaborativeOptimizer:
         # (contributes weight 0, still receives the group average)
         state_sync_retries: int = 2,  # bounded state-download retry with
         state_sync_backoff: float = 0.5,  # exponential backoff (averager)
+        telemetry_registry=None,  # per-peer telemetry scope, forwarded to
+        # the averager/matchmaking/RPC stack (telemetry/registry.py); None
+        # falls back to the process-global registry at each site
     ):
         assert not (client_mode and auxiliary), "an auxiliary peer must listen"
         self.tx = tx
@@ -143,6 +147,7 @@ class CollaborativeOptimizer:
         # peer's params may have drifted while it was away, so it re-ramps.
         self._rounds_since_join = 0
         self._last_loss: Optional[float] = None
+        self.telemetry = telemetry_registry
 
         self.averager = DecentralizedAverager(
             dht,
@@ -163,6 +168,7 @@ class CollaborativeOptimizer:
             relay=relay,
             state_sync_retries=state_sync_retries,
             state_sync_backoff=state_sync_backoff,
+            telemetry_registry=telemetry_registry,
         )
         self.tracker = ProgressTracker(
             dht,
@@ -247,6 +253,11 @@ class CollaborativeOptimizer:
         target batch is reached."""
         assert not self.auxiliary, "auxiliary peers must use step_aux()"
         with self._lock:
+            tele = telemetry.resolve(self.telemetry)
+            if tele is not None and samples > 0:
+                # accumulation-boundary trace; samples == 0 is a retry poll
+                # while a round assembles, not a boundary
+                tele.counter("opt.boundaries").inc()
             self.local_samples_accumulated += samples
             if self._ema_started:
                 # samples == 0 is a retry poll while a round assembles —
@@ -275,6 +286,12 @@ class CollaborativeOptimizer:
             ):
                 # we fell FAR behind (or our last round failed while others
                 # averaged) — catch up from peers: full state download
+                if tele is not None:
+                    tele.counter("opt.catch_ups").inc()
+                    tele.event(
+                        "opt.catch_up", gap=gap, desynced=self._desynced,
+                        local_step=self.local_step,
+                    )
                 state = self._catch_up(state, collab)
                 self._desynced = False
                 grad_acc = zeros_like_grads(state.params)
@@ -383,6 +400,15 @@ class CollaborativeOptimizer:
             )
         self._desynced = True
         self._round_failures = 0
+        tele = telemetry.resolve(self.telemetry)
+        if tele is not None:
+            # applied-vs-dropped ledger: the swarm-health view surfaces a
+            # peer whose gradients keep getting discarded
+            tele.counter("opt.grads_dropped").inc()
+            tele.event(
+                "opt.grads_dropped", round_id=round_id,
+                samples=self.local_samples_accumulated, reason="health_gate",
+            )
         self.local_samples_accumulated = 0
         return (
             state,
@@ -425,6 +451,21 @@ class CollaborativeOptimizer:
         # MIXES IN (it still receives the full group average) — a fresh or
         # diverged joiner must not steer a formed trunk (docs/fleet.md)
         weight_scale = self.mixing_weight_scale(collab)
+        tele = telemetry.resolve(self.telemetry)
+        if tele is not None:
+            # every ramp/gate decision is a trace event: the operator can
+            # replay exactly when a joiner reached full weight or a diverged
+            # peer was gated out of the mix
+            gated = weight_scale == 0.0
+            tele.gauge("opt.weight_scale").set(weight_scale)
+            if gated:
+                tele.counter("opt.gate_engaged").inc()
+            tele.event(
+                "opt.weight_decision", round_id=round_id,
+                scale=weight_scale, gated=gated,
+                rounds_since_join=self._rounds_since_join,
+                loss=self._last_loss,
+            )
         if (
             collab.num_peers_near_step <= 1
             and not self.client_mode
@@ -574,6 +615,14 @@ class CollaborativeOptimizer:
                 step=pre[0], params=pre[1], opt_state=pre[2]
             )
         self.seam_ms["apply"] = (time.perf_counter() - t0) * 1e3
+        tele = telemetry.resolve(self.telemetry)
+        if tele is not None:
+            tele.counter("opt.grads_applied").inc()
+            tele.event(
+                "opt.global_step", step=collab.optimizer_step + 1,
+                group_size=group_size,
+                samples=self.local_samples_accumulated,
+            )
         self.local_step = collab.optimizer_step + 1
         self._rounds_since_join += 1  # advances the contribution ramp
         self.local_samples_accumulated = 0

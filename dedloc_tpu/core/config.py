@@ -310,6 +310,23 @@ class TrainingArguments:
 
 
 @dataclass
+class TelemetryArguments:
+    """Swarm telemetry (dedloc_tpu/telemetry, docs/observability.md): a
+    process-local registry of counters/histograms + span tracing across the
+    DHT/averaging/optimizer seams. One flag: disabled (the default) costs
+    one attribute load per instrumented site and emits nothing."""
+
+    enabled: bool = False
+    # per-peer JSONL event log ("" = in-memory trace only); rendered by
+    # ``python tools/runlog_summary.py --health <events.jsonl> ...``
+    event_log_path: str = ""
+    # seconds between snapshots of this peer's counters onto the signed DHT
+    # metrics bus (LocalMetrics.telemetry) — the coordinator aggregates them
+    # into its swarm-health JSONL record
+    snapshot_period: float = 30.0
+
+
+@dataclass
 class AuthArguments:
     """Gated-run credentials (sahajbert/huggingface_auth.py capability):
     when ``username`` is set, the role fetches a signed access token from
@@ -331,6 +348,7 @@ class CollaborationArguments:
     )
     training: TrainingArguments = field(default_factory=TrainingArguments)
     auth: AuthArguments = field(default_factory=AuthArguments)
+    telemetry: TelemetryArguments = field(default_factory=TelemetryArguments)
     wandb_project: Optional[str] = None
     bandwidth: float = 1000.0
 
@@ -392,3 +410,4 @@ class SwAVCollaborationArguments:
     training: SwAVTrainingArguments = field(
         default_factory=SwAVTrainingArguments
     )
+    telemetry: TelemetryArguments = field(default_factory=TelemetryArguments)

@@ -24,6 +24,7 @@ import struct
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 from dedloc_tpu.core.serialization import pack_obj, unpack_obj
+from dedloc_tpu.telemetry import registry as telemetry
 from dedloc_tpu.testing import faults
 from dedloc_tpu.utils.logging import get_logger
 
@@ -40,6 +41,8 @@ async def read_frame(reader: asyncio.StreamReader) -> Any:
     if length > MAX_FRAME:
         raise ValueError(f"frame too large: {length}")
     payload = await reader.readexactly(length)
+    if telemetry._active is not None:  # process-wide wire accounting
+        telemetry._active.counter("net.bytes_in").inc(_LEN.size + length)
     return unpack_obj(payload)
 
 
@@ -47,6 +50,10 @@ def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
     payload = pack_obj(obj)
     writer.write(_LEN.pack(len(payload)))
     writer.write(payload)
+    if telemetry._active is not None:  # process-wide wire accounting
+        telemetry._active.counter("net.bytes_out").inc(
+            _LEN.size + len(payload)
+        )
 
 
 Handler = Callable[[Endpoint, Dict[str, Any]], Awaitable[Any]]
@@ -70,8 +77,12 @@ class RPCServer:
     """Serves named RPC methods; one task per connection, many requests per
     connection (pipelined)."""
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 telemetry_registry=None):
         self.host, self.requested_port = host, port
+        # per-peer scope for in-process multi-peer tests; None falls back to
+        # the process-global registry (production: one peer per process)
+        self.telemetry = telemetry_registry
         self._handlers: Dict[str, Handler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._writers: set = set()
@@ -169,12 +180,23 @@ class RPCServer:
     async def _dispatch(self, peer, msg, writer) -> None:
         req_id = msg.get("id")
         method = msg.get("method")
+        tele = telemetry.resolve(self.telemetry)
+        if tele is not None:
+            tele.counter("rpc.server.requests").inc()
         if faults._active is not None:  # fault injection (testing/faults.py)
             fault = faults.fire(
                 "rpc.server.dispatch", method=method, peer=peer, server=self,
                 port=self.port,
             )
             if fault is not None:
+                if tele is not None:
+                    # attribute the APPLIED fault to this peer's registry
+                    # (faults.fire also logs a process-global trace event)
+                    tele.counter("faults.applied").inc()
+                    tele.event(
+                        "fault.applied", point="rpc.server.dispatch",
+                        action=fault.action, method=method,
+                    )
                 try:
                     await faults.apply_transport_fault(fault, f"rpc {method}")
                 except (ConnectionResetError, OSError):
@@ -194,6 +216,8 @@ class RPCServer:
             reply = {"id": req_id, "ok": True, "result": result}
         except Exception as e:  # noqa: BLE001 — RPC boundary
             logger.debug(f"rpc {method} failed: {e!r}")
+            if tele is not None:
+                tele.counter("rpc.server.errors").inc()
             reply = {"id": req_id, "ok": False, "error": repr(e)}
         try:
             write_frame(writer, reply)
@@ -205,8 +229,11 @@ class RPCServer:
 class RPCClient:
     """Pooled msgpack-RPC client: one persistent connection per endpoint."""
 
-    def __init__(self, request_timeout: float = 5.0):
+    def __init__(self, request_timeout: float = 5.0, telemetry_registry=None):
         self.request_timeout = request_timeout
+        # per-peer scope for in-process multi-peer tests; None falls back to
+        # the process-global registry (production: one peer per process)
+        self.telemetry = telemetry_registry
         self._conns: Dict[Endpoint, Tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
         self._pending: Dict[Endpoint, Dict[int, asyncio.Future]] = {}
         self._readers: Dict[Endpoint, asyncio.Task] = {}
@@ -308,6 +335,13 @@ class RPCClient:
         conn = self._conns.pop(endpoint, None)
         if conn is not None:
             conn[1].close()
+            tele = telemetry.resolve(self.telemetry)
+            if tele is not None:
+                tele.counter("rpc.conns_lost").inc()
+                tele.event(
+                    "rpc.conn_lost", endpoint=endpoint,
+                    error=type(exc).__name__,
+                )
         task = self._readers.pop(endpoint, None)
         if task is not None:
             task.cancel()
@@ -329,13 +363,27 @@ class RPCClient:
         reversal / hole punch, dht/nat.py), and finally a ``relay.call``
         wrapped to the public peer hosting the registration (circuit
         relay)."""
+        tele = telemetry.resolve(self.telemetry)
         if faults._active is not None:  # fault injection (testing/faults.py)
             fault = faults.fire(
                 "rpc.client.call", method=method, endpoint=endpoint,
                 client=self,
             )
             if fault is not None:
-                await faults.apply_transport_fault(fault, f"rpc {method}")
+                if tele is not None:
+                    tele.counter("faults.applied").inc()
+                    tele.event(
+                        "fault.applied", point="rpc.client.call",
+                        action=fault.action, method=method,
+                        endpoint=endpoint,
+                    )
+                try:
+                    await faults.apply_transport_fault(fault, f"rpc {method}")
+                except Exception:
+                    if tele is not None:
+                        tele.counter("rpc.client.calls").inc()
+                        tele.counter("rpc.client.failures").inc()
+                    raise
         relayed = parse_relay_endpoint(endpoint)
         if relayed is not None:
             relay, peer_hex = relayed
@@ -349,13 +397,20 @@ class RPCClient:
                     writer = self.nat.direct_writer(peer_hex)
                     if writer is not None and self.nat.server is not None:
                         # reversal route: the target dialed us back; call it
-                        # over the parked inbound connection
+                        # over the parked inbound connection. Counted like
+                        # the dialed leaf below — a half-open reversal route
+                        # timing out must show up in rpc.client.failures or
+                        # the swarm-health view misses the stalling peer.
+                        if tele is not None:
+                            tele.counter("rpc.client.calls").inc()
                         try:
                             return await self.nat.server.call_over(
                                 writer, method, args or {},
                                 timeout=timeout or self.request_timeout,
                             )
                         except RPCError:
+                            if tele is not None:
+                                tele.counter("rpc.client.remote_errors").inc()
                             raise  # remote answered — the route is alive
                         except asyncio.TimeoutError:
                             # half-open reversal route (NAT mapping expiry,
@@ -365,11 +420,25 @@ class RPCClient:
                             # already spent, so retrying inline would make a
                             # timeout=T call take ~2T — callers' straggler
                             # deadlines must stay honest.
+                            if tele is not None:
+                                tele.counter("rpc.client.failures").inc()
+                                tele.event(
+                                    "rpc.client.failure", method=method,
+                                    endpoint=endpoint, error="TimeoutError",
+                                    route="reversal",
+                                )
                             self.nat.drop_route(peer_hex)
                             raise
-                        except (ConnectionError, OSError):
+                        except (ConnectionError, OSError) as e:
                             # instant transport failure (no budget burned):
                             # evict and fall back to the relay inline
+                            if tele is not None:
+                                tele.counter("rpc.client.failures").inc()
+                                tele.event(
+                                    "rpc.client.failure", method=method,
+                                    endpoint=endpoint,
+                                    error=type(e).__name__, route="reversal",
+                                )
                             self.nat.drop_route(peer_hex)
                             route = None
                     else:
@@ -388,21 +457,43 @@ class RPCClient:
                     timeout=inner_timeout + 5.0,
                 )
         endpoint = (endpoint[0], int(endpoint[1]))
-        _, writer = await self._connect(endpoint)
-        self._next_id += 1
-        req_id = self._next_id
-        fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._pending[endpoint][req_id] = fut
+        # counted at the LEAF (after relay/NAT resolution): one count per
+        # wire RPC, never double-counted through the relay recursion
+        if tele is not None:
+            tele.counter("rpc.client.calls").inc()
+        try:
+            _, writer = await self._connect(endpoint)
+            self._next_id += 1
+            req_id = self._next_id
+            fut: asyncio.Future = asyncio.get_event_loop().create_future()
+            self._pending[endpoint][req_id] = fut
+        except (asyncio.TimeoutError, ConnectionError, OSError) as e:
+            if tele is not None:
+                tele.counter("rpc.client.failures").inc()
+                tele.event(
+                    "rpc.client.failure", method=method, endpoint=endpoint,
+                    error=type(e).__name__,
+                )
+            raise
         write_frame(writer, {"id": req_id, "method": method, "args": args or {}})
         try:
             await writer.drain()
             reply = await asyncio.wait_for(
                 fut, timeout=timeout or self.request_timeout
             )
-        except (asyncio.TimeoutError, ConnectionError, OSError):
+        except (asyncio.TimeoutError, ConnectionError, OSError) as e:
             self._pending.get(endpoint, {}).pop(req_id, None)
+            if tele is not None:
+                tele.counter("rpc.client.failures").inc()
+                tele.event(
+                    "rpc.client.failure", method=method, endpoint=endpoint,
+                    error=type(e).__name__,
+                )
             raise
         if not reply.get("ok"):
+            if tele is not None:
+                # the transport worked; the remote handler refused/crashed
+                tele.counter("rpc.client.remote_errors").inc()
             raise RPCError(reply.get("error", "unknown remote error"))
         return reply.get("result")
 

@@ -71,6 +71,12 @@ def run_aux(
     tx = build_optimizer(args)
     dht, _public_key = build_dht(args)
     logger.info(f"aux peer DHT listening on {dht.port}")
+    # swarm telemetry (--telemetry.*, docs/observability.md): an aux donor's
+    # join failures / allreduce stragglers are exactly the events operators
+    # need when a donor silently loses every matchmaking race
+    from dedloc_tpu.roles.common import configure_role_telemetry
+
+    _tele, tele_close = configure_role_telemetry(args, _public_key)
     opt = CollaborativeOptimizer(
         tx,
         dht,
@@ -121,6 +127,7 @@ def run_aux(
                 break
             time.sleep(poll_interval)
     finally:
+        tele_close()
         opt.shutdown()
         dht.shutdown()
     return rounds

@@ -148,6 +148,29 @@ def build_dht(args: CollaborationArguments, client_mode: Optional[bool] = None):
     return dht, public_key
 
 
+def configure_role_telemetry(args, public_key: bytes):
+    """Install the process-global swarm-telemetry registry for a role
+    (docs/observability.md, ``--telemetry.*`` knobs). THE one place the
+    peer label is derived: the sha1 fingerprint ``fetch_metrics`` computes
+    from the signed metrics subkey, so per-peer event logs and the
+    coordinator's swarm-health rows join on the same id. Returns
+    ``(registry_or_None, close_fn)``; call ``close_fn()`` on shutdown."""
+    import hashlib
+
+    from dedloc_tpu import telemetry
+
+    tele = telemetry.configure(
+        args.telemetry, peer=hashlib.sha1(public_key).hexdigest()[:12]
+    )
+
+    def close() -> None:
+        if tele is not None:
+            tele.close()
+            telemetry.uninstall(tele)
+
+    return tele, close
+
+
 def build_loss_fn(model: AlbertForPreTraining) -> Callable:
     """Gathered masked-position loss when the batch carries ``mlm_positions``
     (the fast TPU layout); dense per-position loss otherwise. With an MoE

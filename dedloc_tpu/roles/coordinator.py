@@ -23,6 +23,7 @@ from dedloc_tpu.collaborative.metrics import aggregate_metrics, fetch_metrics
 from dedloc_tpu.core.config import CollaborationArguments, parse_config
 from dedloc_tpu.core.timeutils import get_dht_time
 from dedloc_tpu.roles.common import build_dht, force_cpu_if_requested
+from dedloc_tpu.telemetry import build_swarm_health
 from dedloc_tpu.utils.checkpoint import save_checkpoint
 from dedloc_tpu.utils.logging import get_logger
 
@@ -68,6 +69,12 @@ def run_coordinator(
         )
     dht, _public_key = build_dht(args)
     logger.info(f"coordinator DHT root listening on {dht.port}")
+    # swarm telemetry (--telemetry.*): the coordinator's own counters —
+    # notably metrics.malformed_records from fetch_metrics — need a registry
+    # too, or they are silently discarded
+    from dedloc_tpu.roles.common import configure_role_telemetry
+
+    _tele, tele_close = configure_role_telemetry(args, _public_key)
 
     if extra.auth_allowlist:
         from dedloc_tpu.core.auth import AllowlistAuthServer, AuthService
@@ -110,6 +117,18 @@ def run_coordinator(
             if agg is not None and agg["step"] > current_step:
                 current_step = agg["step"]
                 agg["time"] = get_dht_time()
+                # swarm health (telemetry/health.py): per-peer retry/fault
+                # counters off the signed metrics bus folded into straggler
+                # attribution + retry rates — the durable "why was step N
+                # slow" record next to the throughput aggregate
+                health = build_swarm_health(metrics)
+                if health is not None:
+                    agg["swarm_health"] = health
+                    if health["straggler"] is not None:
+                        logger.warning(
+                            f"step {agg['step']}: straggler "
+                            f"{health['straggler']} is stalling the swarm"
+                        )
                 logger.info(
                     f"step {agg['step']}: {agg['alive_peers']} peers, "
                     f"{agg['samples_per_second']:.1f} samples/s, "
@@ -145,6 +164,7 @@ def run_coordinator(
             t.join(timeout=330.0)
         if averager is not None:
             averager.shutdown()
+        tele_close()
         dht.shutdown()
 
 

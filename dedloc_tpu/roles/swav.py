@@ -81,6 +81,11 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
     cfg, spec, model, tx = build_swav(args)
     dht, _public_key = build_dht(args)
     logger.info(f"swav peer DHT listening on {dht.port}")
+    # swarm telemetry (--telemetry.*, docs/observability.md): same wiring as
+    # the ALBERT trainer; disabled (default) costs nothing
+    from dedloc_tpu.roles.common import configure_role_telemetry
+
+    tele, tele_close = configure_role_telemetry(args, _public_key)
 
     # slice-as-one-peer (same mapping as the ALBERT trainer): crops shard
     # over the data axis, so the sinkhorn sums inside the jitted loss ride
@@ -252,7 +257,37 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
         if _stepped:
             # advertise the loss for the trunk-health gate — one host sync
             # per GLOBAL step, the same cadence the ALBERT trainer pays
-            opt.report_loss(float(loss))
+            loss_host = float(loss)
+            opt.report_loss(loss_host)
+            # ride the signed metrics bus like the ALBERT trainer
+            # (run_first_peer.py:176-218 aggregation): the coordinator's
+            # throughput/loss aggregate and swarm-health view work for SwAV
+            # fleets too, with the throttled telemetry tail attached
+            from dedloc_tpu.collaborative.metrics import (
+                LocalMetrics,
+                publish_metrics,
+            )
+
+            publish_metrics(
+                dht,
+                args.dht.experiment_prefix,
+                _public_key,
+                LocalMetrics(
+                    step=opt.local_step,
+                    samples_per_second=float(
+                        opt.performance_ema.samples_per_second
+                    ),
+                    samples_accumulated=samples,
+                    loss=loss_host,
+                    mini_steps=1,
+                    telemetry=(
+                        tele.maybe_snapshot(args.telemetry.snapshot_period)
+                        if tele is not None
+                        else None
+                    ),
+                ),
+                expiration=args.optimizer.statistics_expiration,
+            )
         return state, {"loss": loss, "global_step": opt.local_step}
 
     def _put_crops(crops):
@@ -305,6 +340,7 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
             max_steps=t.max_local_steps or 10**9,
         )
     finally:
+        tele_close()
         opt.shutdown()
         dht.shutdown()
     return state

@@ -34,6 +34,7 @@ from dedloc_tpu.roles.common import (
     build_loss_fn,
     build_model,
     build_optimizer,
+    configure_role_telemetry,
     drop_collator_keys,
     force_cpu_if_requested,
     synthetic_mlm_batches,
@@ -145,6 +146,9 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
     tx = build_optimizer(args)
     dht, public_key = build_dht(args)
     logger.info(f"trainer DHT listening on {dht.port}")
+    # swarm telemetry (--telemetry.*, docs/observability.md): disabled
+    # (default) => None and the instrumented seams stay free
+    tele, tele_close = configure_role_telemetry(args, public_key)
 
     rng = jax.random.PRNGKey(args.training.seed)
     seq = min(args.training.seq_length, cfg.max_position_embeddings)
@@ -391,6 +395,14 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
                         data_wait_ms=perf.metric("data_wait").recent_mean * 1e3,
                         allreduce_ms=perf.metric("allreduce").recent_mean * 1e3,
                         hbm_bytes=_hbm_bytes_in_use(),
+                        # throttled counter snapshot for the coordinator's
+                        # swarm-health aggregation (refreshed at most once
+                        # per period; stale-but-present between refreshes)
+                        telemetry=(
+                            tele.maybe_snapshot(args.telemetry.snapshot_period)
+                            if tele is not None
+                            else None
+                        ),
                     ),
                     expiration=args.optimizer.statistics_expiration,
                 )
@@ -459,6 +471,7 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
     finally:
         if train_log is not None:
             train_log.close()
+        tele_close()
         opt.shutdown()
         dht.shutdown()
     return state

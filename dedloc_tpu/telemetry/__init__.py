@@ -1,0 +1,59 @@
+"""Swarm telemetry layer: counters + span tracing across DHT / averaging /
+optimizer, with coordinator swarm-health aggregation.
+
+See ``registry`` (the per-peer metric registry + event trace, zero overhead
+when disabled), ``health`` (coordinator aggregation over the signed metrics
+bus), and docs/observability.md for the operator view.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from dedloc_tpu.telemetry import registry
+from dedloc_tpu.telemetry.health import build_swarm_health
+from dedloc_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Telemetry,
+    active,
+    enabled,
+    event,
+    inc,
+    install,
+    monotonic_clock,
+    resolve,
+    span,
+    uninstall,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Telemetry",
+    "active",
+    "build_swarm_health",
+    "configure",
+    "enabled",
+    "event",
+    "inc",
+    "install",
+    "monotonic_clock",
+    "registry",
+    "resolve",
+    "span",
+    "uninstall",
+]
+
+
+def configure(args, peer: str = "") -> Optional[Telemetry]:
+    """Role-entry wiring: install the process-global registry from a
+    ``TelemetryArguments`` block (core/config.py ``--telemetry.*`` knobs).
+    Returns the installed registry, or None when telemetry is disabled —
+    the instrumented seams then cost one attribute load each."""
+    if not getattr(args, "enabled", False):
+        return None
+    return install(
+        Telemetry(peer=peer, event_log_path=args.event_log_path or None)
+    )

@@ -1,0 +1,114 @@
+"""Coordinator-side swarm-health aggregation.
+
+Per-peer telemetry snapshots ride the signed DHT metrics bus
+(``LocalMetrics.telemetry``, one RSA-signed subkey per peer — spoof-
+resistant, so a peer cannot blame its retries on someone else). The
+coordinator folds them into ONE swarm-health record per aggregation tick,
+appended to its metrics JSONL next to the throughput aggregate: straggler
+attribution, per-peer retry/fault rates, and round-formation latency — the
+"why was step N slow" view the reference could only answer by reading every
+volunteer's stderr.
+
+Record shape (see docs/observability.md):
+
+    {"current_step": N,
+     "peers": [{"peer": "ab12…", "step": N, "behind": 0,
+                "rpc_failures": 0.0, "rounds_attempted": 3.0, ...}, ...],
+     "straggler": "<peer label of the worst offender, or None>",
+     "retry_rate": <state-sync retries / attempts, swarm-wide>,
+     "round_formation_s": <mean mm.form_group latency across peers>,
+     "faults_injected": <total fault events (test harnesses only)>}
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+# counter names lifted from the instrumented seams; a missing key reads 0.0
+# so peers running older builds (no telemetry tail) still aggregate
+_PEER_COUNTERS = {
+    "rpc_failures": "rpc.client.failures",
+    "rpc_calls": "rpc.client.calls",
+    "rounds_attempted": "mm.rounds_attempted",
+    "rounds_formed": "mm.rounds_formed",
+    "rounds_aborted": "mm.rounds_aborted",
+    "join_failures": "mm.join_failures",
+    "leader_changes": "mm.leader_changes",
+    "state_sync_attempts": "state_sync.attempts",
+    "state_sync_retries": "state_sync.retries",
+    "state_sync_failures": "state_sync.failures",
+    "checksum_failures": "state_sync.checksum_failures",
+    "grads_dropped": "opt.grads_dropped",
+    "grads_applied": "opt.grads_applied",
+    "faults_injected": "faults.applied",
+}
+
+
+def _peer_entry(m, current_step: int) -> Dict:
+    t = m.telemetry or {}
+    entry: Dict = {
+        "peer": m.peer,
+        "step": m.step,
+        "behind": max(0, current_step - m.step),
+        "samples_per_second": m.samples_per_second,
+    }
+    if m.step_time_ms is not None:
+        entry["step_time_ms"] = m.step_time_ms
+    for out_key, counter in _PEER_COUNTERS.items():
+        entry[out_key] = float(t.get(counter, 0.0))
+    form = t.get("mm.form_group.mean")
+    if form is not None:
+        entry["round_formation_s"] = float(form)
+    round_dur = t.get("avg.round.mean")
+    if round_dur is not None:
+        entry["round_s"] = float(round_dur)
+    return entry
+
+
+def _straggler(peers: List[Dict]) -> Optional[str]:
+    """The peer most likely stalling the swarm: deepest behind the current
+    step; ties (everyone current) break on the slowest step-phase wall. None
+    when nothing distinguishes anyone (healthy swarm).
+
+    behind == 1 is NOT attributed: the coordinator aggregates at the moment
+    the FIRST peer's new-step record lands, so a healthy peer whose publish
+    or DHT propagation lags by seconds still reads one step behind at that
+    tick — naming it would warn on every step advance of a healthy fleet."""
+    if not peers:
+        return None
+    behind = max(peers, key=lambda p: p["behind"])
+    if behind["behind"] >= 2:
+        return behind["peer"]
+    timed = [p for p in peers if p.get("step_time_ms") is not None]
+    if len(timed) >= 2:
+        slowest = max(timed, key=lambda p: p["step_time_ms"])
+        rest = [p["step_time_ms"] for p in timed if p is not slowest]
+        # only call out a peer that is clearly off the pack (2x the mean of
+        # the others) — a healthy swarm has no straggler
+        if slowest["step_time_ms"] > 2.0 * (sum(rest) / len(rest) + 1e-9):
+            return slowest["peer"]
+    return None
+
+
+def build_swarm_health(records) -> Optional[Dict]:
+    """Fold fetched per-peer ``LocalMetrics`` (collaborative/metrics.py)
+    into one swarm-health record. Returns None when there are no records;
+    peers without a telemetry tail still contribute step/throughput rows."""
+    if not records:
+        return None
+    current_step = max(m.step for m in records)
+    peers = [_peer_entry(m, current_step) for m in records]
+    attempts = sum(p["state_sync_attempts"] for p in peers)
+    retries = sum(p["state_sync_retries"] for p in peers)
+    formation = [
+        p["round_formation_s"] for p in peers if "round_formation_s" in p
+    ]
+    health: Dict = {
+        "current_step": current_step,
+        "peers": peers,
+        "straggler": _straggler(peers),
+        "retry_rate": (retries / attempts) if attempts else 0.0,
+        "faults_injected": sum(p["faults_injected"] for p in peers),
+    }
+    if formation:
+        health["round_formation_s"] = sum(formation) / len(formation)
+    return health

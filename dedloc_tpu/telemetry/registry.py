@@ -1,0 +1,337 @@
+"""Process-local swarm telemetry: counters/gauges/histograms + span tracing.
+
+DeDLOC's operational reality is a fleet of unreliable volunteer peers — the
+operator's only lever is knowing WHICH peer is stalling a round. The
+reference leans on hivemind's logs plus a wandb dashboard; the step-phase
+half lives in ``utils/perf.py`` (vissl PerfStats capability). This module is
+the collaborative-machinery half: structured counters and span traces on the
+hot seams (DHT RPCs, matchmaking, allreduce rounds, state-sync retries,
+ramp/gate decisions, injected faults), written to a per-peer JSONL event log
+and periodically snapshotted onto the signed DHT metrics bus
+(``collaborative/metrics.py``) so the coordinator can aggregate swarm health
+(``telemetry/health.py``).
+
+Design rules, mirroring ``testing/faults.py``:
+
+- **Zero overhead when disabled.** Instrumented code checks the module-level
+  ``_active`` attribute (one load + identity test) before touching anything;
+  production with telemetry off pays exactly that. Nothing here imports jax.
+- **Scoped or global.** Production runs one peer per process, so the roles
+  install ONE process-global registry (``install``/``configure``). In-process
+  multi-peer tests pass a per-peer ``Telemetry`` instance into the components
+  (averager/optimizer/matchmaking/protocol accept ``telemetry=``) so events
+  and counters attribute to the right simulated peer; components fall back to
+  the global registry when no instance was given (``resolve``).
+- **FakeClock-compatible.** Timestamps are ``get_dht_time()`` (scenario time:
+  deterministic under ``testing.faults.FakeClock``); span durations use a
+  monotonic clock that also advances with the fake-clock offset, so fault
+  scenarios replay to deterministic traces and production durations never go
+  backwards on an NTP step.
+
+Event-log schema (one JSON object per line; see docs/observability.md):
+
+    {"t": <dht time>, "peer": "<label>", "event": "<name>",
+     "dur_s": <float, spans only>, ...site-specific attributes}
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Callable, Deque, Dict, Iterator, List, Optional
+
+from dedloc_tpu.core import timeutils
+from dedloc_tpu.core.timeutils import get_dht_time
+
+
+def monotonic_clock() -> float:
+    """Monotonic duration clock that also honours the FakeClock offset:
+    ``FakeClock.advance(n)`` moves it forward by ``n`` exactly, so scripted
+    fault scenarios produce deterministic span durations while production
+    (offset 0) gets plain ``time.monotonic``."""
+    return time.monotonic() + timeutils._dht_time_offset
+
+
+class Counter:
+    """Monotonically-increasing float (events, bytes, failures). ``lock``
+    is the owning registry's: ``+=`` is a non-atomic load/add/store in
+    CPython and counters are hit from the trainer thread AND DHT loop
+    threads concurrently — unlocked increments silently undercount."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock: threading.Lock) -> None:
+        self.value = 0.0
+        self._lock = lock
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """Last-write-wins scalar (queue depths, weight scales)."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock: threading.Lock) -> None:
+        self.value = 0.0
+        self._lock = lock
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+
+class Histogram:
+    """Online duration/size stats: count/total/min/max + recent window
+    (the PerfMetric shape, utils/perf.py, minus the jax blocking)."""
+
+    WINDOW = 64
+
+    __slots__ = ("count", "total", "min", "max", "_recent", "_lock")
+
+    def __init__(self, lock: threading.Lock) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = 0.0
+        self._recent: Deque[float] = deque(maxlen=self.WINDOW)
+        self._lock = lock
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = min(self.min, v)
+            self.max = max(self.max, v)
+            self._recent.append(v)
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "count": float(self.count),
+            "mean": self.mean,
+            "min": 0.0 if not self.count else self.min,
+            "max": self.max,
+        }
+
+
+def _jsonable(v: Any) -> Any:
+    """Event attributes must serialize: keep scalars, stringify the rest
+    (endpoints, peer ids, exceptions) so a fault-context object can never
+    crash the telemetry path."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, bytes):
+        return v.hex()[:16]
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return str(v)
+
+
+class Telemetry:
+    """One peer's telemetry registry: named counters/gauges/histograms plus
+    a bounded in-memory event trace, optionally mirrored to a JSONL file.
+
+    Thread-safe: metrics are touched from the trainer thread AND the DHT
+    event loop; one lock guards registry lookup and every metric mutation
+    (orders of magnitude cheaper than the RPCs they instrument), and the
+    JSONL mirror has its OWN lock so a slow disk never blocks counters.
+    """
+
+    MAX_EVENTS = 4096  # in-memory trace bound; the JSONL file is unbounded
+
+    def __init__(
+        self,
+        peer: str = "",
+        event_log_path: Optional[str] = None,
+        clock: Optional[Callable[[], float]] = None,
+    ) -> None:
+        self.peer = peer
+        self.clock = clock or monotonic_clock
+        self.counters: Dict[str, Counter] = {}
+        self.gauges: Dict[str, Gauge] = {}
+        self.histograms: Dict[str, Histogram] = {}
+        self.events: Deque[dict] = deque(maxlen=self.MAX_EVENTS)
+        self._lock = threading.Lock()
+        # the JSONL mirror gets its OWN lock: a slow disk stalling an event
+        # write must not block counter updates on the DHT event loop
+        self._log_lock = threading.Lock()
+        self._log = (
+            open(event_log_path, "a", buffering=1, encoding="utf-8")
+            if event_log_path
+            else None
+        )
+        self._last_snapshot_at: Optional[float] = None
+        self._last_snapshot: Optional[Dict[str, float]] = None
+
+    # ------------------------------------------------------------- metrics
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self.counters.get(name)
+            if c is None:
+                c = self.counters[name] = Counter(self._lock)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self.gauges.get(name)
+            if g is None:
+                g = self.gauges[name] = Gauge(self._lock)
+            return g
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self.histograms.get(name)
+            if h is None:
+                h = self.histograms[name] = Histogram(self._lock)
+            return h
+
+    # -------------------------------------------------------------- events
+
+    def event(self, name: str, **attrs: Any) -> dict:
+        """Record a point event (and mirror it to the JSONL log)."""
+        record = {"t": get_dht_time(), "peer": self.peer, "event": name}
+        for k, v in attrs.items():
+            record[k] = _jsonable(v)
+        self.events.append(record)  # deque.append is atomic under the GIL
+        if self._log is not None:
+            line = json.dumps(record) + "\n"
+            with self._log_lock:
+                try:
+                    if self._log is not None:
+                        self._log.write(line)
+                except (OSError, ValueError):
+                    # a full disk / closed file must never kill training
+                    pass
+        return record
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[Dict[str, Any]]:
+        """Trace a region: yields a mutable attrs dict the caller can
+        annotate with the outcome (``ctx["ok"] = True``); on exit the span
+        becomes one event carrying ``dur_s`` and feeds the histogram of the
+        same name."""
+        ctx: Dict[str, Any] = dict(attrs)
+        start = self.clock()
+        try:
+            yield ctx
+        finally:
+            # clamped at 0: a span that straddles a FakeClock exit sees the
+            # clock retreat by the whole fake offset — a huge negative
+            # duration would poison the histogram min/mean forever
+            dur = max(0.0, self.clock() - start)
+            self.histogram(name).observe(dur)
+            self.event(name, dur_s=dur, **ctx)
+
+    # ----------------------------------------------------------- snapshots
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat {name: float} view of every metric — the payload that rides
+        the signed DHT metrics bus (LocalMetrics.telemetry). Histograms
+        flatten to ``<name>.count`` / ``<name>.mean`` / ``<name>.max``."""
+        with self._lock:
+            out: Dict[str, float] = {}
+            for name, c in self.counters.items():
+                out[name] = c.value
+            for name, g in self.gauges.items():
+                out[name] = g.value
+            for name, h in self.histograms.items():
+                if h.count:
+                    out[f"{name}.count"] = float(h.count)
+                    out[f"{name}.mean"] = h.mean
+                    out[f"{name}.max"] = h.max
+            return out
+
+    def maybe_snapshot(self, period: float) -> Dict[str, float]:
+        """Snapshot freshly at most once per ``period`` seconds (the
+        metrics-bus throttle); between refreshes the PREVIOUS snapshot is
+        returned rather than None — each publish OVERWRITES the peer's DHT
+        subkey, so a None tail on the latest record would zero the
+        coordinator's swarm-health counters for most aggregation ticks. A
+        slightly stale tail beats a missing one."""
+        now = self.clock()
+        if (
+            self._last_snapshot is None
+            or self._last_snapshot_at is None
+            or now - self._last_snapshot_at >= period
+            # clock retreated (FakeClock exited): refresh rather than serve
+            # the frozen pre-exit snapshot until real time catches up
+            or now < self._last_snapshot_at
+        ):
+            self._last_snapshot_at = now
+            self._last_snapshot = self.snapshot()
+        return self._last_snapshot
+
+    def close(self) -> None:
+        with self._log_lock:
+            if self._log is not None:
+                self._log.close()
+                self._log = None
+
+
+# ---------------------------------------------------------------------------
+# Process-global registry (one peer per process in production). Instrumented
+# code checks ``registry._active is not None`` directly — one attribute load,
+# the same production fast path as testing/faults.py.
+# ---------------------------------------------------------------------------
+
+_active: Optional[Telemetry] = None
+
+
+def install(telemetry: Telemetry) -> Telemetry:
+    global _active
+    _active = telemetry
+    return telemetry
+
+
+def uninstall(telemetry: Optional[Telemetry] = None) -> None:
+    global _active
+    if telemetry is None or _active is telemetry:
+        _active = None
+
+
+def active() -> Optional[Telemetry]:
+    return _active
+
+
+def enabled() -> bool:
+    return _active is not None
+
+
+def resolve(local: Optional[Telemetry]) -> Optional[Telemetry]:
+    """Component-scoped registry if one was injected, else the process
+    global, else None (disabled)."""
+    return local if local is not None else _active
+
+
+# cheap helpers for free functions that have no component scope (frame I/O,
+# fault firing); all no-ops while telemetry is disabled
+def inc(name: str, n: float = 1.0) -> None:
+    if _active is not None:
+        _active.counter(name).inc(n)
+
+
+def event(name: str, **attrs: Any) -> None:
+    if _active is not None:
+        _active.event(name, **attrs)
+
+
+@contextmanager
+def null_span() -> Iterator[Dict[str, Any]]:
+    """Shared no-op span for disabled telemetry (lets call sites keep one
+    ``with`` shape)."""
+    yield {}
+
+
+def span(name: str, telemetry: Optional[Telemetry] = None, **attrs: Any):
+    tele = resolve(telemetry)
+    return tele.span(name, **attrs) if tele is not None else null_span()

@@ -124,6 +124,23 @@ class FaultSchedule:
             if fault.times > 0:
                 fault.times -= 1
             self.fired.append((point, context))
+            # every injected fault is a trace event (docs/observability.md):
+            # the process-global registry gets the schedule-level view; the
+            # instrumented site additionally attributes a "fault.applied"
+            # event to its own per-peer registry. Import deferred — the
+            # production fast path (no schedule installed) never pays it.
+            from dedloc_tpu.telemetry import registry as telemetry
+
+            if telemetry._active is not None:
+                telemetry._active.counter("faults.injected").inc()
+                telemetry._active.event(
+                    "fault.injected", point=point, action=fault.action,
+                    **{
+                        k: v
+                        for k, v in context.items()
+                        if isinstance(v, (str, int, float, bool, bytes))
+                    },
+                )
             return fault
         return None
 

@@ -20,6 +20,7 @@
 #if defined(__x86_64__) || defined(_M_X64)
 #define WIRECODEC_X86 1
 #include <immintrin.h>
+#include <cpuid.h>
 #endif
 
 extern "C" {
@@ -104,9 +105,23 @@ static void f16_to_f32_hw(const uint16_t* src, float* dst, int64_t n) {
     for (; i < n; i++) dst[i] = f16_to_f32_scalar(src[i]);
 }
 
+static bool has_f16c_uncached() {
+    // raw CPUID instead of __builtin_cpu_supports("f16c"): the "f16c"
+    // feature name only exists in GCC >= 11, and the container toolchain
+    // (gcc 10) rejects it at compile time. CPUID leaf 1 ECX: F16C bit 29,
+    // AVX bit 28, OSXSAVE bit 27; the OS must also have enabled the YMM
+    // state (XCR0 bits 1-2) or the AVX paths fault at runtime.
+    unsigned int eax, ebx, ecx, edx;
+    if (!__get_cpuid(1, &eax, &ebx, &ecx, &edx)) return false;
+    const unsigned int need = (1u << 29) | (1u << 28) | (1u << 27);
+    if ((ecx & need) != need) return false;
+    unsigned int xlo, xhi;
+    __asm__ volatile("xgetbv" : "=a"(xlo), "=d"(xhi) : "c"(0));
+    return (xlo & 0x6u) == 0x6u;
+}
+
 static bool has_f16c() {
-    static const bool ok =
-        __builtin_cpu_supports("f16c") && __builtin_cpu_supports("avx");
+    static const bool ok = has_f16c_uncached();
     return ok;
 }
 #endif

@@ -1,0 +1,71 @@
+"""utils/logging.py: invalid DEDLOC_LOGLEVEL must fall back to INFO instead
+of crashing the first logger call of the process, and configuration must be
+race-free (trainer thread, DHT loop and backup threads all call get_logger
+on first use)."""
+import logging
+import threading
+
+import pytest
+
+from dedloc_tpu.utils import logging as ulog
+
+
+@pytest.fixture
+def reconfigurable(monkeypatch):
+    """Reset the one-shot configuration flag for the test and restore the
+    package logger's handlers/level afterwards (the suite's other tests
+    must keep exactly one handler)."""
+    root = logging.getLogger("dedloc_tpu")
+    before_handlers = list(root.handlers)
+    before_level = root.level
+    monkeypatch.setattr(ulog, "_configured", False)
+    yield root
+    root.handlers = before_handlers
+    root.setLevel(before_level)
+    ulog._configured = True
+
+
+def test_resolve_level_accepts_names_and_ints_rejects_garbage():
+    assert ulog._resolve_level("DEBUG") == logging.DEBUG
+    assert ulog._resolve_level("15") == 15
+    assert ulog._resolve_level("NOTALEVEL") is None
+    assert ulog._resolve_level("Level 15") is None
+
+
+def test_invalid_loglevel_falls_back_to_info(monkeypatch, reconfigurable):
+    monkeypatch.setenv("DEDLOC_LOGLEVEL", "bogus")
+    ulog.get_logger("fallback_check")
+    assert reconfigurable.level == logging.INFO
+
+
+def test_valid_loglevel_applies(monkeypatch, reconfigurable):
+    monkeypatch.setenv("DEDLOC_LOGLEVEL", "debug")
+    ulog.get_logger("level_check")
+    assert reconfigurable.level == logging.DEBUG
+
+
+def test_configuration_races_add_exactly_one_handler(
+    monkeypatch, reconfigurable
+):
+    monkeypatch.setenv("DEDLOC_LOGLEVEL", "INFO")
+    before = len(reconfigurable.handlers)
+    barrier = threading.Barrier(8)
+
+    def hammer():
+        barrier.wait()
+        for _ in range(10):
+            ulog.get_logger("race_check")
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(reconfigurable.handlers) == before + 1, (
+        "concurrent first calls must configure exactly once"
+    )
+
+
+def test_bare_names_fold_under_the_package_root():
+    assert ulog.get_logger("__main__").name == "dedloc_tpu.__main__"
+    assert ulog.get_logger("dedloc_tpu.sub").name == "dedloc_tpu.sub"

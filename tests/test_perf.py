@@ -1,0 +1,76 @@
+"""utils/perf.py coverage (previously untested): the TPU-native blocking
+timer path, the enabled=False no-op, the falsy profiler gate, and the
+report formatting BASELINE tables are copied from."""
+import pytest
+
+from dedloc_tpu.utils.perf import PerfMetric, PerfStats, profiler_trace
+
+
+def test_timer_block_on_blocks_before_stopping_the_clock():
+    """``block_on`` is the TPU analogue of CUDA-event timing: the timer must
+    call jax.block_until_ready on the pytree before recording — an async
+    dispatch must not be timed as ~0."""
+    jnp = pytest.importorskip("jax.numpy")
+
+    stats = PerfStats()
+    result = {}
+    with stats.timer("forward", block_on=result):
+        # the pytree handed to block_on is resolved at exit time, so the
+        # value produced INSIDE the block is what gets blocked on
+        result["out"] = jnp.arange(128) * 2
+    m = stats.metric("forward")
+    assert m.count == 1
+    assert m.total > 0.0
+    # the blocked-on value is fully materialized after the timer exits
+    assert int(result["out"][3]) == 6
+
+
+def test_disabled_stats_record_nothing():
+    stats = PerfStats(enabled=False)
+    with stats.timer("forward"):
+        pass
+    with stats.timer("backward", block_on=None):
+        pass
+    assert stats.metrics == {}, "disabled stats must not allocate metrics"
+    assert stats.report() == {}
+
+
+def test_profiler_trace_falsy_log_dir_is_a_noop():
+    """A falsy log_dir must gate the whole jax.profiler path off — the body
+    still runs, nothing is traced, nothing is imported or started."""
+    ran = []
+    with profiler_trace(None):
+        ran.append("none")
+    with profiler_trace(""):
+        ran.append("empty")
+    assert ran == ["none", "empty"]
+
+
+def test_report_str_formats_known_values():
+    stats = PerfStats()
+    stats.metric("read_sample").update(0.5)  # 500 ms
+    stats.metric("read_sample").update(0.25)  # recent mean 375 ms
+    text = stats.report_str()
+    lines = text.splitlines()
+    assert lines[0].startswith("phase")
+    (row,) = [ln for ln in lines[1:] if "read_sample" in ln]
+    assert "2" in row  # count
+    assert "375.00" in row  # mean/recent over [500, 250]
+    assert "500.00" in row  # max
+    # reset drops everything back to the bare header
+    stats.reset()
+    assert stats.report_str().splitlines() == [lines[0]]
+
+
+def test_perf_metric_window_and_extremes():
+    m = PerfMetric()
+    for v in (0.1, 0.2, 0.3):
+        m.update(v)
+    assert m.count == 3
+    assert m.min == pytest.approx(0.1)
+    assert m.max == pytest.approx(0.3)
+    assert m.mean == pytest.approx(0.2)
+    s = m.summary()
+    assert s["mean_ms"] == pytest.approx(200.0)
+    # empty metric reports 0 min (not inf) so tables never print "inf"
+    assert PerfMetric().summary()["min_ms"] == 0.0

@@ -52,3 +52,82 @@ def test_missing_requested_steps_warn(tmp_path, capsys):
     )
     assert picked == [1]
     assert "500" in capsys.readouterr().err
+
+
+# ------------------------------------------------- smoke: old + new schemas
+# (the tool must not drift from the emitters: roles/trainer.py writes the
+# train_log schema, telemetry/registry.py writes the event-log schema)
+
+
+def test_main_smoke_over_old_trainlog_schema(tmp_path, capsys):
+    rows = [
+        {"wall_s": 10.0, "step": 1, "loss": 11.0, "boundary_ms": 120.0,
+         "seam_ms": {"apply": 3.0}},
+        {"wall_s": 40.0, "step": 2, "loss": 10.0, "boundary_ms": 110.0,
+         "seam_ms": {"apply": 2.5}},
+    ]
+    runlog_summary.main([_write(tmp_path, rows)])
+    out = capsys.readouterr().out
+    assert "| global step | wall (min) | train loss |" in out
+    assert "| 2 |" in out
+    assert "total: 2 global steps" in out
+
+
+def _write_events(tmp_path, rows, name="events.jsonl"):
+    p = tmp_path / name
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    return str(p)
+
+
+def test_health_view_renders_rounds_faults_and_per_peer_table(
+    tmp_path, capsys
+):
+    events = [
+        {"t": 100.0, "peer": "peerA", "event": "avg.round", "dur_s": 0.5,
+         "round_id": "step1", "ok": True, "group_size": 2},
+        {"t": 100.2, "peer": "peerB", "event": "fault.applied",
+         "point": "averager.state_get", "action": "truncate"},
+        {"t": 100.25, "peer": "peerA", "event": "state_sync.checksum_failure",
+         "provider": ["127.0.0.1", 4567], "attempt": 1},
+        {"t": 100.3, "peer": "peerA", "event": "state_sync.retry",
+         "attempt": 1, "backoff_s": 0.05},
+        {"t": 100.4, "peer": "peerA", "event": "rpc.client.failure",
+         "method": "state.get", "error": "TimeoutError"},
+        {"t": 100.5, "peer": "peerA", "event": "mm.join_failed",
+         "round_id": "step1", "error": "ConnectionResetError"},
+    ]
+    runlog_summary.main(["--health", _write_events(tmp_path, events)])
+    out = capsys.readouterr().out
+    assert "round timeline:" in out
+    assert "step1" in out and "group=2" in out and " ok" in out
+    assert "injected faults:" in out and "truncate" in out
+    # per-peer table: peerA has 1 retry, 1 checksum fail, 1 rpc failure,
+    # 1 join failure
+    (row_a,) = [ln for ln in out.splitlines() if ln.startswith("| peerA |")]
+    assert row_a == "| peerA | 5 | 0 | 1 | 1 | 1 | 1 | 0 |"
+    (row_b,) = [ln for ln in out.splitlines() if ln.startswith("| peerB |")]
+    assert row_b == "| peerB | 1 | 1 | 0 | 0 | 0 | 0 | 0 |"
+
+
+def test_health_view_merges_logs_and_skips_old_schema_rows(tmp_path, capsys):
+    """Several peers' event logs merge into one timeline (sorted by t), and
+    an old-schema train_log row mixed into a file is skipped, not fatal."""
+    a = _write_events(
+        tmp_path,
+        [{"t": 200.0, "peer": "a", "event": "avg.round", "dur_s": 0.1,
+          "round_id": "step2", "ok": True},
+         {"wall_s": 1.0, "step": 1, "loss": 2.0}],  # old schema: ignored
+        name="a.jsonl",
+    )
+    b = _write_events(
+        tmp_path,
+        [{"t": 100.0, "peer": "b", "event": "avg.round", "dur_s": 0.2,
+          "round_id": "step1", "ok": False}],
+        name="b.jsonl",
+    )
+    runlog_summary.main(["--health", a, b])
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if "avg.round" in ln]
+    assert len(lines) == 2
+    assert "step1" in lines[0] and "FAILED" in lines[0]  # earliest t first
+    assert "step2" in lines[1]

@@ -1,0 +1,493 @@
+"""Swarm telemetry: registry semantics, per-peer attribution under the
+deterministic fault harness, coordinator swarm-health aggregation, and the
+zero-emission guarantee when telemetry is disabled.
+
+The acceptance scenario replays a multi-peer run under FaultSchedule +
+FakeClock (leader death mid-matchmaking + truncated state download) and
+asserts the coordinator's swarm-health JSONL attributes the injected
+retries/faults to the RIGHT peer — the "which peer is stalling the round"
+question DeDLOC operators otherwise answer by reading every volunteer's
+stderr."""
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+
+from dedloc_tpu import telemetry
+from dedloc_tpu.averaging.matchmaking import Matchmaking
+from dedloc_tpu.collaborative.metrics import LocalMetrics
+from dedloc_tpu.dht.node import DHTNode
+from dedloc_tpu.dht.protocol import RPCClient, RPCServer
+from dedloc_tpu.telemetry import Telemetry, build_swarm_health, registry
+from dedloc_tpu.testing.faults import FakeClock, FaultSchedule
+
+pytestmark = pytest.mark.telemetry
+
+
+# ------------------------------------------------------------ registry core
+
+
+def test_counters_gauges_histograms_and_snapshot():
+    t = Telemetry(peer="p0")
+    t.counter("rpc.calls").inc()
+    t.counter("rpc.calls").inc(2)
+    t.gauge("weight").set(0.25)
+    t.histogram("round").observe(1.0)
+    t.histogram("round").observe(3.0)
+    snap = t.snapshot()
+    assert snap["rpc.calls"] == 3.0
+    assert snap["weight"] == 0.25
+    assert snap["round.count"] == 2.0
+    assert snap["round.mean"] == 2.0
+    assert snap["round.max"] == 3.0
+
+
+def test_span_is_fakeclock_deterministic_and_annotatable():
+    """Span durations ride a monotonic clock that advances with the fake
+    DHT-clock offset: a scripted scenario that advances 5 fake seconds
+    inside a span produces a ~5s trace, replayably."""
+    with FakeClock(start=1_000.0) as clock:
+        t = Telemetry(peer="p0")
+        with t.span("mm.form_group", round_id="r1") as ctx:
+            clock.advance(5.0)
+            ctx["ok"] = True
+        (event,) = list(t.events)
+        assert event["event"] == "mm.form_group"
+        assert event["round_id"] == "r1" and event["ok"] is True
+        assert 5.0 <= event["dur_s"] < 6.0
+        assert t.histogram("mm.form_group").count == 1
+
+
+def test_event_log_jsonl_roundtrip(tmp_path):
+    path = tmp_path / "events.jsonl"
+    t = Telemetry(peer="p0", event_log_path=str(path))
+    t.event("fault.injected", point="rpc.server.dispatch", action="drop",
+            endpoint=("127.0.0.1", 1234), peer_id=b"\xab\xcd")
+    t.close()
+    (row,) = [json.loads(l) for l in path.read_text().splitlines()]
+    assert row["event"] == "fault.injected"
+    assert row["peer"] == "p0"
+    assert row["action"] == "drop"
+    assert row["endpoint"] == ["127.0.0.1", 1234]
+    assert row["peer_id"] == "abcd"  # bytes stringify to a hex prefix
+
+
+def test_maybe_snapshot_throttles_but_never_returns_none():
+    t = Telemetry(peer="p0")
+    t.counter("c").inc()
+    assert t.maybe_snapshot(period=3600.0) == {"c": 1.0}
+    t.counter("c").inc()
+    # inside the period: the PREVIOUS snapshot rides again — each publish
+    # overwrites the peer's DHT subkey, so a None tail would zero the
+    # coordinator's swarm-health counters between refreshes
+    assert t.maybe_snapshot(period=3600.0) == {"c": 1.0}
+    assert t.maybe_snapshot(period=0.0) == {"c": 2.0}
+
+
+def test_install_scope_and_module_helpers():
+    assert registry.active() is None
+    t = Telemetry(peer="p0")
+    try:
+        telemetry.install(t)
+        assert registry.active() is t
+        telemetry.inc("x", 2)
+        telemetry.event("e", k="v")
+        assert t.counters["x"].value == 2.0
+        assert list(t.events)[-1]["event"] == "e"
+        # component scope wins over the global
+        local = Telemetry(peer="p1")
+        assert registry.resolve(local) is local
+        assert registry.resolve(None) is t
+    finally:
+        telemetry.uninstall(t)
+    assert registry.active() is None
+    telemetry.inc("x")  # must be a silent no-op when disabled
+
+
+# ----------------------------------- acceptance: faults attributed per peer
+
+
+def _mm_peer(node, prefix, tele, request_timeout=10.0):
+    client = RPCClient(request_timeout=request_timeout,
+                       telemetry_registry=tele)
+    server = RPCServer("127.0.0.1", 0, telemetry_registry=tele)
+    return client, server
+
+
+def test_multi_peer_fault_replay_attributes_to_the_right_peer(tmp_path):
+    """The acceptance scenario: under FaultSchedule + FakeClock, (1) a
+    declared leader dies mid-matchmaking and the survivors regroup, (2) the
+    survivor's first state download is truncated and heals over one backoff
+    retry. Each simulated peer carries its own Telemetry registry; the
+    injected faults and the retries they provoke must land on the right
+    peer's counters, and the coordinator's swarm-health JSONL must say so."""
+    from dedloc_tpu.averaging.averager import DecentralizedAverager
+    from dedloc_tpu.dht import DHT
+
+    tele_leader = Telemetry(
+        peer="leader", event_log_path=str(tmp_path / "leader.jsonl")
+    )
+    tele_survivor = Telemetry(
+        peer="survivor", event_log_path=str(tmp_path / "survivor.jsonl")
+    )
+    tele_provider = Telemetry(
+        peer="provider", event_log_path=str(tmp_path / "provider.jsonl")
+    )
+
+    # ---- part 1: leader death mid-matchmaking (3 peers, survivors regroup)
+    async def leader_death():
+        first = await DHTNode.create(listen_host="127.0.0.1")
+        nodes = [first] + [
+            await DHTNode.create(listen_host="127.0.0.1",
+                                 initial_peers=[first.endpoint])
+            for _ in range(2)
+        ]
+        teles = [tele_leader, tele_survivor, tele_provider]
+        servers, clients, mms = [], [], []
+        for node, tele in zip(nodes, teles):
+            client, server = _mm_peer(node, "healthmm", tele)
+            await server.start()
+            clients.append(client)
+            servers.append(server)
+            mms.append(
+                Matchmaking(
+                    node, client, server, "healthmm",
+                    node.node_id.to_bytes(), ("127.0.0.1", server.port),
+                    bandwidth=1.0, averaging_expiration=30.0,
+                    telemetry_registry=tele,
+                )
+            )
+        try:
+            lead_task = asyncio.ensure_future(mms[0].form_group("r1"))
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if any(
+                    lid == mms[0].peer_id
+                    for lid, _ep in await mms[1]._live_leaders("r1")
+                ):
+                    break
+                await asyncio.sleep(0.02)
+            else:
+                raise AssertionError("leader record never appeared")
+            # process-death semantics, both directions: joins TO the dead
+            # leader reset, and its own outbound joins reset too
+            schedule.inject(
+                "rpc.server.dispatch", "drop", times=-1,
+                match=lambda ctx: ctx["server"] is servers[0]
+                and ctx["method"] == "mm.join",
+            )
+            schedule.inject(
+                "rpc.client.call", "drop", times=-1,
+                match=lambda ctx: ctx["client"] is clients[0]
+                and ctx["method"] == "mm.join",
+            )
+            g1, g2 = await asyncio.gather(
+                mms[1].form_group("r1", expected_size=2),
+                mms[2].form_group("r1", expected_size=2),
+            )
+            survivors = {mms[1].peer_id, mms[2].peer_id}
+            assert {m.peer_id for m in g1.members} == survivors
+            assert {m.peer_id for m in g2.members} == survivors
+            assert schedule.fired, "the death fault never triggered"
+            clock.advance(120.0)
+            await asyncio.wait_for(lead_task, timeout=30)
+        finally:
+            for c in clients:
+                await c.close()
+            for s in servers:
+                await s.stop()
+            for node in nodes:
+                await node.shutdown()
+
+    with FakeClock(start=10_000.0) as clock, FaultSchedule(seed=0) as schedule:
+        asyncio.run(leader_death())
+
+        # the DROPPED joins were applied at the DEAD LEADER's transport
+        # (server inbound and client outbound both belong to it); the
+        # resulting join failures landed on the survivors — not vice versa
+        snap_leader = tele_leader.snapshot()
+        assert snap_leader.get("faults.applied", 0) >= 1
+        join_failures = (
+            tele_survivor.snapshot().get("mm.join_failures", 0)
+            + tele_provider.snapshot().get("mm.join_failures", 0)
+        )
+        assert join_failures >= 1, "a survivor must have hit the dead leader"
+        for tele in (tele_survivor, tele_provider):
+            assert tele.snapshot().get("mm.rounds_formed", 0) >= 1
+            assert tele.snapshot().get("faults.applied", 0) == 0
+
+    # ---- part 2: truncated state download, healed by one backoff retry
+    with FakeClock(start=20_000.0), FaultSchedule(seed=0) as schedule:
+        dht1 = DHT(start=True, listen_host="127.0.0.1")
+        dht2 = DHT(start=True, listen_host="127.0.0.1",
+                   initial_peers=[dht1.get_visible_address()])
+        provider = joiner = None
+        try:
+            provider = DecentralizedAverager(
+                dht1, "healthsync", listen_host="127.0.0.1",
+                telemetry_registry=tele_provider,
+            )
+            joiner = DecentralizedAverager(
+                dht2, "healthsync", listen_host="127.0.0.1",
+                state_sync_retries=2, state_sync_backoff=0.05,
+                telemetry_registry=tele_survivor,
+            )
+            tree = {"w": np.arange(64, dtype=np.float32)}
+            provider.set_shared_state(tree, {"step": 7})
+            provider.publish_state_provider(expiration=600.0, step=7)
+            schedule.inject(
+                "averager.state_get", "truncate", times=1, fraction=0.5
+            )
+            result = joiner.load_state_from_peers(timeout=15.0)
+            assert result is not None, "backoff retry must recover the state"
+        finally:
+            for avg in (provider, joiner):
+                if avg is not None:
+                    avg.shutdown()
+            dht2.shutdown()
+            dht1.shutdown()
+
+    # the truncation was APPLIED at the provider; the checksum failure and
+    # the retry it provoked belong to the downloading survivor
+    snap_provider = tele_provider.snapshot()
+    snap_survivor = tele_survivor.snapshot()
+    assert snap_provider.get("faults.applied", 0) == 1
+    assert snap_provider.get("state.served", 0) >= 2
+    assert snap_provider.get("state_sync.retries", 0) == 0
+    assert snap_survivor.get("state_sync.checksum_failures", 0) == 1
+    assert snap_survivor.get("state_sync.retries", 0) >= 1
+    assert snap_survivor.get("state_sync.ok", 0) == 1
+
+    # the per-peer event logs carry the same story for --health rendering
+    events = [
+        json.loads(l)
+        for l in (tmp_path / "provider.jsonl").read_text().splitlines()
+    ]
+    assert any(
+        e["event"] == "fault.applied" and e["point"] == "averager.state_get"
+        for e in events
+    )
+
+    # ---- coordinator swarm health over the signed metrics bus
+    _assert_coordinator_attributes(
+        tmp_path, tele_leader, tele_survivor, tele_provider
+    )
+
+
+def _metrics_record(step, tele, sps=10.0):
+    return LocalMetrics(
+        step=step, samples_per_second=sps, samples_accumulated=64,
+        loss=2.0, mini_steps=2, telemetry=tele.snapshot(),
+    )
+
+
+def _assert_coordinator_attributes(
+    tmp_path, tele_leader, tele_survivor, tele_provider
+):
+    """Publish each peer's signed snapshot and let the real coordinator
+    aggregate: its JSONL swarm-health record must attribute the faults to
+    leader+provider, the retries to the survivor, and name the (behind)
+    leader as the straggler."""
+    import hashlib
+
+    from dedloc_tpu.collaborative.metrics import publish_metrics
+    from dedloc_tpu.core.config import CollaborationArguments, parse_config
+    from dedloc_tpu.roles.common import build_dht
+    from dedloc_tpu.roles.coordinator import (
+        CoordinatorExtraArguments,
+        run_coordinator,
+    )
+
+    def _args(argv=()):
+        return parse_config(
+            CollaborationArguments,
+            ["--dht.listen_host", "127.0.0.1",
+             "--dht.experiment_prefix", "healthagg",
+             "--training.output_dir", str(tmp_path / "out")] + list(argv),
+        )
+
+    args = _args()
+    log_path = str(tmp_path / "coordinator_metrics.jsonl")
+    dht_a, key_a = build_dht(args)
+    dht_b, key_b = build_dht(
+        _args(["--dht.initial_peers", dht_a.get_visible_address()])
+    )
+    dht_c, key_c = build_dht(
+        _args(["--dht.initial_peers", dht_a.get_visible_address()])
+    )
+    try:
+        # the dead leader is two steps BEHIND (it lost its rounds): named
+        # straggler (behind == 1 is publish skew and never attributed)
+        publish_metrics(dht_a, "healthagg", key_a,
+                        _metrics_record(3, tele_leader, sps=1.0))
+        publish_metrics(dht_b, "healthagg", key_b,
+                        _metrics_record(5, tele_survivor))
+        publish_metrics(dht_c, "healthagg", key_c,
+                        _metrics_record(5, tele_provider))
+        time.sleep(0.3)
+        run_coordinator(
+            _args(["--dht.initial_peers", dht_a.get_visible_address()]),
+            CoordinatorExtraArguments(
+                refresh_period=0.1, metrics_log_path=log_path
+            ),
+            max_iterations=5,
+        )
+    finally:
+        dht_c.shutdown()
+        dht_b.shutdown()
+        dht_a.shutdown()
+
+    with open(log_path) as f:
+        rows = [json.loads(line) for line in f]
+    assert rows, "coordinator wrote no aggregate"
+    health = rows[-1]["swarm_health"]
+    label = lambda key: hashlib.sha1(key).hexdigest()[:12]  # noqa: E731
+    by_peer = {p["peer"]: p for p in health["peers"]}
+    leader, survivor, provider = (
+        by_peer[label(key_a)], by_peer[label(key_b)], by_peer[label(key_c)]
+    )
+    # fault attribution: leader (dropped joins) + provider (truncation)
+    assert leader["faults_injected"] >= 1
+    assert provider["faults_injected"] == 1
+    assert survivor["faults_injected"] == 0
+    # retry attribution: only the survivor retried its state sync
+    assert survivor["state_sync_retries"] >= 1
+    assert survivor["checksum_failures"] == 1
+    assert leader["state_sync_retries"] == 0
+    assert provider["state_sync_retries"] == 0
+    assert survivor["join_failures"] + provider["join_failures"] >= 1
+    # straggler attribution: the peer a step behind the swarm
+    assert health["current_step"] == 5
+    assert health["straggler"] == label(key_a)
+    assert health["retry_rate"] > 0.0
+
+
+# -------------------------------------- disabled: the seams emit NOTHING
+
+
+def test_disabled_telemetry_emits_nothing(tmp_path):
+    """With no registry installed and none injected, the same instrumented
+    paths (fault-injected state sync included) record zero events and zero
+    counters anywhere — the one-flag zero-overhead contract."""
+    from dedloc_tpu.averaging.averager import DecentralizedAverager
+    from dedloc_tpu.dht import DHT
+
+    assert registry.active() is None
+    probe = Telemetry(peer="probe")  # exists during the run, never attached
+    with FakeClock(start=30_000.0), FaultSchedule(seed=0) as schedule:
+        dht1 = DHT(start=True, listen_host="127.0.0.1")
+        dht2 = DHT(start=True, listen_host="127.0.0.1",
+                   initial_peers=[dht1.get_visible_address()])
+        provider = joiner = None
+        try:
+            provider = DecentralizedAverager(
+                dht1, "quiet", listen_host="127.0.0.1"
+            )
+            joiner = DecentralizedAverager(
+                dht2, "quiet", listen_host="127.0.0.1",
+                state_sync_retries=1, state_sync_backoff=0.01,
+            )
+            provider.set_shared_state(
+                {"w": np.ones(8, np.float32)}, {"step": 1}
+            )
+            provider.publish_state_provider(expiration=600.0, step=1)
+            schedule.inject(
+                "averager.state_get", "truncate", times=1, fraction=0.5
+            )
+            assert joiner.load_state_from_peers(timeout=15.0) is not None
+            assert schedule.fired, "the instrumented path really ran"
+        finally:
+            for avg in (provider, joiner):
+                if avg is not None:
+                    avg.shutdown()
+            dht2.shutdown()
+            dht1.shutdown()
+    assert registry.active() is None, "nothing may self-install"
+    assert probe.snapshot() == {}, "no counters may leak into a bystander"
+    assert list(probe.events) == []
+    assert not (tmp_path / "anything.jsonl").exists()
+
+
+# --------------------------------------- satellite: malformed metrics drops
+
+
+def test_fetch_metrics_counts_and_warns_malformed_records_once():
+    from dedloc_tpu.collaborative import metrics as metrics_mod
+    from dedloc_tpu.collaborative.metrics import fetch_metrics
+    from dedloc_tpu.core.timeutils import get_dht_time
+    from dedloc_tpu.dht import DHT
+
+    dht = DHT(start=True, listen_host="127.0.0.1")
+    tele = Telemetry(peer="coord")
+    try:
+        telemetry.install(tele)
+        # no validators attached: garbage lands in the bus unchecked, which
+        # is exactly what fetch_metrics must survive (and now report)
+        dht.store("badmx_metrics", {"garbage": True},
+                  get_dht_time() + 60.0, subkey=b"malformed-peer")
+        dht.store(
+            "badmx_metrics",
+            LocalMetrics(step=1, samples_per_second=1.0,
+                         samples_accumulated=8, loss=1.0,
+                         mini_steps=1).model_dump(),
+            get_dht_time() + 60.0, subkey=b"good-peer",
+        )
+        time.sleep(0.2)
+        before = len(metrics_mod._malformed_warned)
+        got = fetch_metrics(dht, "badmx")
+        assert len(got) == 1, "the valid record must survive"
+        assert tele.snapshot().get("metrics.malformed_records") == 1.0
+        assert len(metrics_mod._malformed_warned) == before + 1
+        # second fetch: counted again, but warned only once per peer
+        fetch_metrics(dht, "badmx")
+        assert tele.snapshot().get("metrics.malformed_records") == 2.0
+        assert len(metrics_mod._malformed_warned) == before + 1
+    finally:
+        telemetry.uninstall(tele)
+        dht.shutdown()
+
+
+# ----------------------------------------------- swarm-health unit behavior
+
+
+def test_build_swarm_health_straggler_and_rates():
+    def rec(step, peer, telemetry_tail=None, step_time_ms=None):
+        return LocalMetrics(
+            step=step, samples_per_second=1.0, samples_accumulated=8,
+            loss=1.0, mini_steps=1, peer=peer, telemetry=telemetry_tail,
+            step_time_ms=step_time_ms,
+        )
+
+    assert build_swarm_health([]) is None
+
+    # behind-step attribution wins
+    health = build_swarm_health([
+        rec(10, "aa", {"state_sync.attempts": 4.0,
+                       "state_sync.retries": 1.0}),
+        rec(8, "bb"),
+    ])
+    assert health["straggler"] == "bb"
+    assert health["retry_rate"] == 0.25
+    assert health["current_step"] == 10
+
+    # behind == 1 is ordinary publish skew at the aggregation tick (the
+    # coordinator fires the moment the FIRST peer advances) — never named
+    health = build_swarm_health([rec(10, "aa"), rec(9, "bb")])
+    assert health["straggler"] is None
+
+    # all current: a clear step-time outlier is the straggler
+    health = build_swarm_health([
+        rec(5, "aa", step_time_ms=100.0),
+        rec(5, "bb", step_time_ms=110.0),
+        rec(5, "cc", step_time_ms=500.0),
+    ])
+    assert health["straggler"] == "cc"
+
+    # healthy swarm: nobody to blame
+    health = build_swarm_health([
+        rec(5, "aa", step_time_ms=100.0),
+        rec(5, "bb", step_time_ms=110.0),
+    ])
+    assert health["straggler"] is None

@@ -1,12 +1,21 @@
-"""Summarize a trainer train_log.jsonl into the BASELINE.md table format.
+"""Summarize a trainer train_log.jsonl into the BASELINE.md table format,
+or render swarm-health views from telemetry event logs.
 
 Usage:
     python tools/runlog_summary.py train_log.jsonl [step step ...]
+    python tools/runlog_summary.py --health events.jsonl [events2.jsonl ...]
 
-Prints a markdown `| global step | wall (min) | loss |` table at the given
-checkpoints (default: a log-spaced selection plus the final step) and the
-phase-telemetry percentiles (boundary/data-wait/allreduce/seam) the trainer
-records per global step.
+Default mode prints a markdown `| global step | wall (min) | loss |` table at
+the given checkpoints (default: a log-spaced selection plus the final step)
+and the phase-telemetry percentiles (boundary/data-wait/allreduce/seam) the
+trainer records per global step.
+
+``--health`` mode reads per-peer telemetry event logs (the
+``--telemetry.event_log_path`` JSONL, schema in docs/observability.md) —
+several peers' logs can be merged in one invocation — and renders the round
+timeline plus a per-peer fault/retry table: which rounds ran, how long each
+took, who injected/suffered faults, who retried state syncs, whose joins
+failed.
 """
 from __future__ import annotations
 
@@ -63,7 +72,116 @@ def percentiles(values):
     return pct(0.50), pct(0.90), pct(0.99)
 
 
+# --------------------------------------------------------------- health view
+# (telemetry event-log schema: {"t", "peer", "event", "dur_s"?, ...attrs};
+# docs/observability.md. Tolerates rows from older emitters — any line with
+# an "event" key renders, unknown events just count toward totals.)
+
+
+def load_events(paths):
+    rows = []
+    dropped = 0
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    # a peer killed mid-write (scripted churn, leader death —
+                    # the very runs this tool renders) leaves a truncated
+                    # final line; skip it, don't die on it
+                    dropped += 1
+                    continue
+                if "event" in row:
+                    rows.append(row)
+    if dropped:
+        print(f"warning: skipped {dropped} unparseable line(s)",
+              file=sys.stderr)
+    rows.sort(key=lambda r: r.get("t", 0.0))
+    return rows
+
+
+_FAULT_EVENTS = ("fault.applied", "fault.injected")
+_RETRY_EVENTS = ("state_sync.retry",)
+_ROUND_EVENTS = ("avg.round", "mm.form_group", "allreduce.round")
+
+
+def print_health(rows):
+    if not rows:
+        sys.exit("no telemetry events found (is --telemetry.enabled set?)")
+    t0 = min(r.get("t", 0.0) for r in rows)
+
+    rounds = [r for r in rows if r["event"] == "avg.round"]
+    if not rounds:  # peers that never reached a full round: show what ran
+        rounds = [r for r in rows if r["event"] in _ROUND_EVENTS]
+    print("round timeline:")
+    if not rounds:
+        print("  (no rounds recorded)")
+    for r in rounds:
+        ok = r.get("ok")
+        flag = "" if ok is None else (" ok" if ok else " FAILED")
+        group = r.get("group_size")
+        group_s = f" group={group}" if group is not None else ""
+        print(
+            f"  +{r.get('t', 0.0) - t0:8.2f}s  peer={r.get('peer', '?'):<12} "
+            f"{r['event']:<14} {r.get('round_id', '?'):<12} "
+            f"dur={r.get('dur_s', 0.0):.3f}s{group_s}{flag}"
+        )
+
+    faults = [r for r in rows if r["event"] in _FAULT_EVENTS]
+    if faults:
+        print("\ninjected faults:")
+        for r in faults:
+            where = r.get("point", r.get("method", "?"))
+            print(
+                f"  +{r.get('t', 0.0) - t0:8.2f}s  "
+                f"peer={r.get('peer', '?'):<12} {r['event']:<14} "
+                f"{where} action={r.get('action', '?')}"
+            )
+
+    per_peer = {}
+    for r in rows:
+        peer = r.get("peer", "?")
+        stats = per_peer.setdefault(
+            peer,
+            {"faults": 0, "retries": 0, "checksum": 0, "rpc_fail": 0,
+             "join_fail": 0, "dropped": 0, "events": 0},
+        )
+        stats["events"] += 1
+        event = r["event"]
+        if event in _FAULT_EVENTS:
+            stats["faults"] += 1
+        elif event in _RETRY_EVENTS:
+            stats["retries"] += 1
+        elif event == "state_sync.checksum_failure":
+            stats["checksum"] += 1
+        elif event == "rpc.client.failure":
+            stats["rpc_fail"] += 1
+        elif event == "mm.join_failed":
+            stats["join_fail"] += 1
+        elif event == "opt.grads_dropped":
+            stats["dropped"] += 1
+
+    print("\n| peer | events | faults | sync retries | checksum fails |"
+          " rpc failures | join failures | grads dropped |")
+    print("|---|---|---|---|---|---|---|---|")
+    for peer in sorted(per_peer):
+        s = per_peer[peer]
+        print(
+            f"| {peer} | {s['events']} | {s['faults']} | {s['retries']} |"
+            f" {s['checksum']} | {s['rpc_fail']} | {s['join_fail']} |"
+            f" {s['dropped']} |"
+        )
+
+
 def main(argv):
+    if argv and argv[0] == "--health":
+        if not argv[1:]:
+            sys.exit("usage: runlog_summary.py --health events.jsonl [...]")
+        print_health(load_events(argv[1:]))
+        return
     rows = load(argv[0])
     if not rows:
         sys.exit(f"{argv[0]}: no log rows")
