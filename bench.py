@@ -123,6 +123,183 @@ def run_codec() -> None:
     }))
 
 
+def run_allreduce_pipeline() -> None:
+    """Wire-path bench (DEDLOC_BENCH=allreduce_pipeline): a full multi-peer
+    group all-reduce over localhost RPC — matchmaking excluded, so the
+    number tracks the averaging WIRE PATH (chunk streaming + compression +
+    eager reduce), not the codec in isolation (DEDLOC_BENCH=codec) and not
+    group formation.
+
+    Reports one JSON line with (a) wire bytes per round at each compression
+    level and (b) round wall time for the chunk-streamed pipeline vs the
+    monolithic-span wire format under a simulated volunteer link (per-peer
+    serialized uplink: fixed per-message latency + bandwidth-proportional
+    transmission — the regime DeDLOC targets). vs_baseline is the
+    pipeline's speedup over the monolithic path on the same link.
+    """
+    import asyncio
+
+    import numpy as np
+
+    from dedloc_tpu.averaging.allreduce import GroupAllReduce
+    from dedloc_tpu.core.serialization import CompressionType
+    from dedloc_tpu.dht.protocol import RPCClient, RPCServer
+
+    tiny = os.environ.get("DEDLOC_BENCH_TINY", "") == "1"
+    # DEDLOC_BENCH_TIMING=0 skips the link-simulation half (part b below):
+    # the wire-bytes half is deterministic and cheap, the timing half costs
+    # seconds of simulated uplink sleeps — tier-1's contract test only
+    # asserts the former
+    timing = os.environ.get("DEDLOC_BENCH_TIMING", "1") != "0"
+    n_peers = 3
+    # bandwidth-weighted spans, the DeDLOC fleet shape: a big-pipe donor
+    # (an aux-style peer) hosts most of the vector, so its SERVE leg is the
+    # round's long pole — exactly where streaming reduced chunks back while
+    # the scatter is still inbound pays off. Symmetric groups barely gain
+    # (every uplink carries scatter+serve either way).
+    peer_bandwidths = [1.0, 1.0, 8.0]
+    if tiny:
+        dim, chunk, rounds = 524_288, 65_536, 2  # 2 MB fp32
+        bandwidth, latency = 8e6, 0.3e-3
+    else:
+        dim, chunk, rounds = 4_194_304, 131_072, 3  # 16 MB fp32
+        bandwidth, latency = 25e6, 1e-3
+
+    class LinkSim:
+        """Per-peer serialized uplink: one transmission at a time, each
+        costing latency + nbytes/bandwidth. Loopback RPC underneath stays
+        real — this only adds the volunteer-link wait."""
+
+        def __init__(self, n):
+            self.locks = [asyncio.Lock() for _ in range(n)]
+
+        async def transmit(self, peer, nbytes):
+            async with self.locks[peer]:
+                await asyncio.sleep(latency + nbytes / bandwidth)
+
+    class MeteredClient(RPCClient):
+        """Counts averaging wire bytes and (optionally) simulates the link."""
+
+        def __init__(self, me, port_to_peer, wire, link=None):
+            super().__init__(request_timeout=60.0)
+            self._me = me
+            self._port_to_peer = port_to_peer
+            self._wire = wire
+            self._link = link
+
+        async def call(self, endpoint, method, args=None, timeout=None):
+            if method == "avg.part" and args and args.get("data") is not None:
+                nbytes = len(args["data"])
+                self._wire["bytes"] += nbytes
+                if self._link is not None:
+                    await self._link.transmit(self._me, nbytes)
+            reply = await super().call(endpoint, method, args, timeout)
+            if method == "avg.get_reduced":
+                nbytes = len(reply["data"])
+                self._wire["bytes"] += nbytes
+                if self._link is not None:
+                    # the reduced chunk rides the HOST's uplink
+                    await self._link.transmit(
+                        self._port_to_peer[endpoint[1]], nbytes
+                    )
+            return reply
+
+    async def one_round(compression, chunk_size, link_enabled, round_id):
+        rng = np.random.default_rng(0)
+        vectors = [
+            rng.standard_normal(dim).astype(np.float32)
+            for _ in range(n_peers)
+        ]
+        servers, clients, reducers = [], [], []
+        wire = {"bytes": 0}
+        link = LinkSim(n_peers) if link_enabled else None
+        for i in range(n_peers):
+            server = RPCServer("127.0.0.1", 0)
+            await server.start()
+            servers.append(server)
+        port_to_peer = {s.port: i for i, s in enumerate(servers)}
+        endpoints = [("127.0.0.1", s.port) for s in servers]
+        for i in range(n_peers):
+            client = MeteredClient(i, port_to_peer, wire, link)
+            clients.append(client)
+            reducers.append(
+                GroupAllReduce(
+                    client, servers[i], compression=compression,
+                    timeout=120.0, chunk_size=chunk_size,
+                )
+            )
+        try:
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(
+                    reducers[i].run(
+                        round_id, i, vectors[i], 1.0, endpoints,
+                        peer_bandwidths,
+                    )
+                    for i in range(n_peers)
+                )
+            )
+            wall = time.perf_counter() - t0
+        finally:
+            for c in clients:
+                await c.close()
+            for s in servers:
+                await s.stop()
+        return wall, wire["bytes"]
+
+    async def bench():
+        # (a) wire bytes per round, per compression level (no link sim)
+        wire_bytes = {}
+        loopback_wall = float("inf")
+        for level in (
+            CompressionType.NONE, CompressionType.FLOAT16,
+            CompressionType.UINT8,
+        ):
+            wall, nbytes = await one_round(
+                level, chunk, False, f"wb-{level.value}"
+            )
+            wire_bytes[level.value] = nbytes
+            if level is CompressionType.FLOAT16:
+                loopback_wall = wall
+
+        # (b) chunk-streamed pipeline vs monolithic spans on the same
+        # simulated link (float16, the shipped default)
+        if not timing:
+            return wire_bytes, loopback_wall, 0.0, 0.0
+        pipelined = monolithic = float("inf")
+        for r in range(rounds):
+            wall, _ = await one_round(
+                CompressionType.FLOAT16, chunk, True, f"pipe-{r}"
+            )
+            pipelined = min(pipelined, wall)
+            wall, _ = await one_round(
+                CompressionType.FLOAT16, 0, True, f"mono-{r}"
+            )
+            monolithic = min(monolithic, wall)
+        return wire_bytes, loopback_wall, pipelined, monolithic
+
+    wire_bytes, loopback_wall, pipelined, monolithic = asyncio.run(bench())
+    # effective rate: raw fp32 gradient bytes averaged per second of round
+    # wall time, per peer (the number a volunteer's step budget feels);
+    # with the link sim skipped it reflects the bare loopback round
+    effective = dim * 4 / (pipelined if timing else loopback_wall)
+    print(json.dumps({
+        "metric": "allreduce_pipeline_effective_bytes_per_sec",
+        "value": round(effective, 1),
+        "unit": "bytes/sec",
+        # speedup of the chunk-streamed pipeline over the monolithic-span
+        # wire format under the same per-message-latency link (0.0 when the
+        # timing half was skipped via DEDLOC_BENCH_TIMING=0)
+        "vs_baseline": round(monolithic / pipelined, 3) if timing else 0.0,
+        "wire_bytes_per_round": wire_bytes,
+        "pipelined_wall_ms": round(pipelined * 1e3, 2),
+        "monolithic_wall_ms": round(monolithic * 1e3, 2),
+        "peers": n_peers,
+        "vector_bytes": dim * 4,
+        "chunk_elems": chunk,
+    }))
+
+
 def run_swav() -> None:
     """SwAV ResNet-50 step bench (DEDLOC_BENCH=swav): the full jitted
     multicrop train step — trunk fwd/bwd over 2x224 + 6x96 crops, prototypes
@@ -301,6 +478,9 @@ def run_longctx() -> None:
 def main() -> None:
     if os.environ.get("DEDLOC_BENCH") == "codec":
         run_codec()
+        return
+    if os.environ.get("DEDLOC_BENCH") == "allreduce_pipeline":
+        run_allreduce_pipeline()
         return
     if os.environ.get("DEDLOC_BENCH") == "swav":
         run_swav()

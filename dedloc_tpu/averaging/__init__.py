@@ -1,4 +1,9 @@
-from dedloc_tpu.averaging.partition import partition_weighted, flatten_tree, unflatten_tree
+from dedloc_tpu.averaging.partition import (
+    TreeLayout,
+    flatten_tree,
+    partition_weighted,
+    unflatten_tree,
+)
 from dedloc_tpu.averaging.allreduce import GroupAllReduce, AllreduceFailed
 from dedloc_tpu.averaging.matchmaking import Matchmaking, GroupInfo
 from dedloc_tpu.averaging.averager import DecentralizedAverager

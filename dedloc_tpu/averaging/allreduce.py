@@ -1,4 +1,5 @@
-"""Fault-tolerant group all-reduce over TCP: reduce-scatter + all-gather.
+"""Fault-tolerant group all-reduce over TCP: pipelined reduce-scatter +
+all-gather with per-chunk streaming.
 
 The cross-slice replacement for hivemind's butterfly all-reduce
 (SURVEY.md §2.6): each group member hosts one bandwidth-weighted span of the
@@ -7,10 +8,24 @@ weighted average of its span, then everyone gathers the reduced spans back.
 Weighted by per-peer sample counts so the result is the exact weighted mean
 of member vectors.
 
+Wire-path pipelining (the hivemind part-streaming capability, TPU-native):
+each span is split into fixed-size chunks (``chunk_size`` elements;
+``chunk_size <= 0`` restores the monolithic-span wire format). Three things
+overlap within one round instead of running back-to-back:
+
+- hosts REDUCE each chunk eagerly, the moment the last expected sender's
+  copy of that chunk arrives — reduction overlaps the remaining transfers;
+- the all-gather STREAMS: every member requests all chunks up front and each
+  request completes the instant that chunk finishes reducing, so reduced
+  chunks ride back over the wire while later chunks are still inbound;
+- a sender's scatter is per-chunk, so a host never waits for a full
+  monolithic span before starting work.
+
 Roles inside a group (capability parity with the reference):
 - normal peer: weight > 0, bandwidth > 0 — sends data AND hosts a span
 - auxiliary peer (run_aux.py): weight == 0, bandwidth > 0 — hosts a span,
-  contributes bandwidth, sends no data
+  contributes bandwidth, sends no data (ONE zero-weight marker per host
+  covers every chunk)
 - client-mode peer (arguments.py:63-65): bandwidth == 0 — sends data and
   pulls results, hosts nothing (outbound connections only)
 
@@ -24,17 +39,19 @@ perturb.
 
 Failure contract (mirrors the reference's straggler SLA,
 albert/arguments.py:23-28): a SENDER that misses the ``straggler_timeout``
-window is simply left out — hosts reduce whatever arrived by then, and all
-members still gather identical spans (consistent result, minus the
-straggler's contribution). A dead HOST is unrecoverable without redundancy:
-its span cannot be gathered, the round raises AllreduceFailed for everyone,
-and the group re-forms next round (the reference's 'group failure costs one
-round' semantics, contributor notebook cell 3).
+window is simply left out — hosts finalize whatever chunks arrived by then,
+and all members still gather identical spans (consistent result, minus the
+straggler's contribution; each chunk is served from exactly one host, so
+every member sees the same bytes). A dead HOST is unrecoverable without
+redundancy: its span cannot be gathered, the round raises AllreduceFailed
+for everyone, and the group re-forms next round (the reference's 'group
+failure costs one round' semantics, contributor notebook cell 3).
 """
 from __future__ import annotations
 
 import asyncio
-from typing import Dict, List, Optional, Sequence, Tuple
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -43,6 +60,7 @@ from dedloc_tpu.core.serialization import (
     CompressionType,
     deserialize_array,
     serialize_array,
+    wire_roundtrip,
 )
 from dedloc_tpu.averaging.partition import partition_weighted
 from dedloc_tpu.dht.protocol import Endpoint, RPCClient, RPCError, RPCServer
@@ -51,23 +69,145 @@ from dedloc_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
+# default chunk: 128Ki fp32 elements = 512 KiB raw per message — small enough
+# that several chunks are in flight per span on real models, large enough
+# that framing/syscall overhead stays negligible
+DEFAULT_CHUNK_SIZE = 131072
+
 
 class AllreduceFailed(Exception):
     pass
 
 
+def span_chunks(
+    lo: int, hi: int, chunk_size: int
+) -> List[Tuple[int, int]]:
+    """Absolute [lo, hi) bounds of each chunk of one span. ``chunk_size <= 0``
+    means no chunking (one chunk per span — the monolithic wire format).
+    Every member derives the identical chunking from the identical spans."""
+    if hi <= lo:
+        return []
+    if chunk_size <= 0:
+        return [(lo, hi)]
+    return [
+        (c, min(c + chunk_size, hi)) for c in range(lo, hi, chunk_size)
+    ]
+
+
+class _ChunkState:
+    """One chunk of MY span: eagerly-accumulated weighted sum + the set of
+    senders whose copy arrived. ``done`` resolves to the reduced fp32 chunk
+    the moment the last expected sender delivers (or the straggler window
+    closes); ``wire`` caches the serialized reply so n-1 gatherers cost one
+    encode."""
+
+    __slots__ = ("acc", "weight", "arrived", "done", "wire")
+
+    def __init__(self):
+        self.acc: Optional[np.ndarray] = None
+        self.weight = 0.0
+        self.arrived: Set[int] = set()
+        self.done: asyncio.Future = (
+            asyncio.get_running_loop().create_future()
+        )
+        self.wire: Optional[bytes] = None
+
+
 class _RoundState:
     def __init__(self):
-        self.parts: Dict[int, Tuple[np.ndarray, float]] = {}  # sender -> (span, weight)
-        self.expected_senders: Optional[set] = None
-        self.arrived = asyncio.Event()
-        self.reduced: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.chunks: Dict[int, _ChunkState] = {}
+        # set by run() on the hosting member; handlers may buffer parts that
+        # arrive first, but no chunk finalizes until these exist
+        self.expected_senders: Optional[Set[int]] = None
+        self.chunk_bounds: Optional[List[Tuple[int, int]]] = None
+        self.local_span: Optional[np.ndarray] = None  # my fp32 span slice
+        self.span_lo = 0
+        self.reduce_s = 0.0  # CPU seconds spent in axpy/scale on this host
 
-    def maybe_complete(self) -> None:
-        if self.expected_senders is not None and self.expected_senders <= set(
-            self.parts
-        ):
-            self.arrived.set()
+    def chunk(self, c: int) -> _ChunkState:
+        if c not in self.chunks:
+            self.chunks[c] = _ChunkState()
+        return self.chunks[c]
+
+    @property
+    def dataless(self) -> Set[int]:
+        """Senders whose zero-weight marker (chunk == -1) covers all chunks."""
+        marker = self.chunks.get(-1)
+        return marker.arrived if marker is not None else set()
+
+    def accumulate(
+        self, c: int, part: np.ndarray, weight: float, own: bool = False
+    ) -> None:
+        """Fold one sender's copy of chunk ``c`` into the eager accumulator.
+        ``own=True`` marks a freshly-deserialized array the state may mutate
+        in place; local slices (possibly views of the caller's reused flat
+        buffer) are copied first."""
+        st = self.chunk(c)
+        t0 = time.perf_counter()
+        if st.acc is None:
+            if own and part.dtype == np.float32 and part.flags["C_CONTIGUOUS"]:
+                st.acc = part
+            else:
+                st.acc = np.array(part, dtype=np.float32)
+            native.scale(st.acc, weight)
+        else:
+            native.axpy(st.acc, part, weight)
+        self.reduce_s += time.perf_counter() - t0
+        st.weight += weight
+
+    def maybe_finalize(self, c: int) -> None:
+        """Resolve chunk ``c`` if every expected sender delivered it (data,
+        or the round-wide zero-weight marker)."""
+        if self.expected_senders is None or c < 0:
+            return
+        st = self.chunks.get(c)
+        if st is None or st.done.done():
+            return
+        if self.expected_senders <= (st.arrived | self.dataless):
+            self.finalize(c)
+
+    def finalize(self, c: int) -> None:
+        """Resolve chunk ``c`` with whatever arrived (straggler finalize
+        path included). Requires run() to have initialized the round."""
+        st = self.chunk(c)
+        if st.done.done():
+            return
+        if st.weight > 0:
+            t0 = time.perf_counter()
+            reduced = native.scale(st.acc, 1.0 / st.weight)
+            self.reduce_s += time.perf_counter() - t0
+        else:
+            # all-aux group: nothing to average; serve my own slice (copied —
+            # local_span may view a flat buffer the caller reuses next round,
+            # and slow members pull chunks after this round returns)
+            lo, hi = self.chunk_bounds[c]
+            reduced = np.array(
+                self.local_span[lo - self.span_lo : hi - self.span_lo],
+                dtype=np.float32,
+            )
+        st.done.set_result(reduced)
+
+    def maybe_finalize_all(self) -> None:
+        if self.expected_senders is None or self.chunk_bounds is None:
+            return
+        for c in range(len(self.chunk_bounds)):
+            self.maybe_finalize(c)
+
+    def finalize_all(self) -> None:
+        for c in range(len(self.chunk_bounds)):
+            self.finalize(c)
+
+    def missing_senders(self) -> Set[int]:
+        """Expected senders that did not deliver every chunk of my span."""
+        if self.expected_senders is None or self.chunk_bounds is None:
+            return set()
+        missing: Set[int] = set()
+        covered = self.dataless
+        for c in range(len(self.chunk_bounds)):
+            st = self.chunks.get(c)
+            arrived = st.arrived if st is not None else set()
+            missing |= self.expected_senders - (arrived | covered)
+        return missing
 
 
 class GroupAllReduce:
@@ -81,6 +221,8 @@ class GroupAllReduce:
         compression: CompressionType = CompressionType.FLOAT16,
         timeout: float = 30.0,
         straggler_timeout: float = 5.0,
+        chunk_size: int = DEFAULT_CHUNK_SIZE,  # elements per wire chunk;
+        # <= 0 disables chunking (one monolithic message per span)
         telemetry_registry=None,  # per-peer scope (telemetry/registry.py)
     ):
         self.client = client
@@ -88,6 +230,7 @@ class GroupAllReduce:
         self.compression = compression
         self.timeout = timeout
         self.straggler_timeout = straggler_timeout
+        self.chunk_size = int(chunk_size)
         self._rounds: Dict[str, _RoundState] = {}
         if server is not None:
             server.register("avg.part", self._rpc_part)
@@ -106,29 +249,46 @@ class GroupAllReduce:
     # ------------------------------------------------------------- handlers
 
     async def _rpc_part(self, peer: Endpoint, args) -> dict:
-        """A sender delivers its slice of MY span (or a zero-weight marker
-        from an auxiliary peer that has no data)."""
+        """A sender delivers one chunk of MY span (``chunk == -1``: a
+        zero-weight marker from an auxiliary peer with no data, covering
+        every chunk of the round)."""
         state = self._round(args["round_id"])
+        sender = int(args["sender"])
         weight = float(args["weight"])
-        span = (
-            deserialize_array(args["data"]).astype(np.float32)
-            if args.get("data") is not None
-            else None
-        )
-        state.parts[int(args["sender"])] = (span, weight)
-        state.maybe_complete()
+        c = int(args.get("chunk", 0))
+        data = args.get("data")
+        if data is None or c < 0:
+            # round-wide marker: this sender contributes nothing, ever
+            state.chunk(-1).arrived.add(sender)
+            state.maybe_finalize_all()
+            return {}
+        st = state.chunk(c)
+        if sender in st.arrived or sender in state.dataless:
+            return {}  # duplicate delivery must not double-accumulate
+        if st.done.done():
+            # a straggler's part landing AFTER the window finalized this
+            # chunk: the finalized mean (scaled in place, possibly already
+            # served to gatherers) must never be mutated again — the late
+            # sender simply missed this round, per the straggler SLA
+            return {}
+        part = deserialize_array(data)
+        if weight > 0:
+            state.accumulate(c, part, weight, own=True)
+        st.arrived.add(sender)
+        state.maybe_finalize(c)
         return {}
 
     async def _rpc_get_reduced(self, peer: Endpoint, args) -> dict:
-        """A member pulls my reduced span (awaits until reduction done)."""
+        """A member pulls one reduced chunk of my span (awaits until that
+        chunk finishes reducing — the streaming all-gather)."""
         state = self._round(args["round_id"])
-        data, weight = await asyncio.wait_for(
-            asyncio.shield(state.reduced), timeout=self.timeout
+        st = state.chunk(int(args.get("chunk", 0)))
+        data = await asyncio.wait_for(
+            asyncio.shield(st.done), timeout=self.timeout
         )
-        return {
-            "data": serialize_array(data, self.compression, checksum=True),
-            "weight": weight,
-        }
+        if st.wire is None:  # encode once, serve n-1 gatherers from cache
+            st.wire = serialize_array(data, self.compression, checksum=True)
+        return {"data": st.wire}
 
     # ------------------------------------------------------------------ run
 
@@ -140,13 +300,25 @@ class GroupAllReduce:
         weight: float,
         endpoints: Sequence[Optional[Endpoint]],
         bandwidths: Sequence[float],
+        chunk_size: Optional[int] = None,
     ) -> np.ndarray:
         """Run one round. ``endpoints[i] is None`` marks a client-mode member
         (it hosts nothing); my own endpoint entry is ignored. Returns the
-        weighted average vector (same shape as input).
+        weighted average vector (same shape as input) in a freshly allocated
+        buffer — the result ESCAPES the round (callers hold it across rounds,
+        e.g. an overlapped optimizer boundary), so it cannot alias a reused
+        scratch buffer.
+
+        ``chunk_size`` overrides this instance's default for ONE round —
+        the averager passes the group-negotiated value here, since chunk
+        indices only mean the same thing when every member splits the
+        identical spans with the identical chunk size.
         """
         n = len(endpoints)
         assert 0 <= my_index < n
+        chunk_size = (
+            self.chunk_size if chunk_size is None else int(chunk_size)
+        )
         can_host = [ep is not None for ep in endpoints]
         if not any(can_host):
             raise AllreduceFailed(f"round {round_id}: no member can host a span")
@@ -161,7 +333,18 @@ class GroupAllReduce:
         if hosts_span:
             my_state = self._round(round_id)
             my_state.expected_senders = set(senders)
-            my_state.maybe_complete()
+            my_state.chunk_bounds = span_chunks(lo, hi, chunk_size)
+            my_state.span_lo = lo
+            my_state.local_span = np.ascontiguousarray(
+                vector[lo:hi], dtype=np.float32
+            )
+            for c in range(len(my_state.chunk_bounds)):
+                # pre-create every chunk state: maybe_finalize skips chunks
+                # it has never seen, so an all-dataless round whose markers
+                # all landed BEFORE run() would otherwise finalize nothing
+                # eagerly and idle out the full straggler window
+                my_state.chunk(c)
+            my_state.maybe_finalize_all()
 
         tele = telemetry.resolve(self.telemetry)
         span_cm = (
@@ -175,7 +358,7 @@ class GroupAllReduce:
                     result = await asyncio.wait_for(
                         self._run_inner(
                             round_id, my_index, vector, weight, endpoints,
-                            spans, my_state, senders,
+                            spans, my_state, senders, ctx, chunk_size,
                         ),
                         timeout=self.timeout,
                     )
@@ -197,6 +380,8 @@ class GroupAllReduce:
                     tele.counter("allreduce.rounds").inc()
                     ctx["ok"] = True
                     ctx["bytes"] = int(vector.nbytes)
+                    if my_state is not None:
+                        ctx["reduce_s"] = round(my_state.reduce_s, 6)
                 return result
         finally:
             # deferred cleanup: slower members may still pull our reduced span
@@ -206,95 +391,209 @@ class GroupAllReduce:
 
     async def _run_inner(
         self, round_id, my_index, vector, weight, endpoints, spans, my_state,
-        senders,
+        senders, ctx, chunk_size,
     ) -> np.ndarray:
         n = len(endpoints)
         tele = telemetry.resolve(self.telemetry)
-        # 1) scatter: send my slice of each host's span (zero-weight marker
-        # when I have no data, so hosts never wait on an aux peer)
-        sends = []
-        for j in range(n):
-            lo, hi = spans[j]
-            if hi <= lo:
-                continue  # client-mode host: nothing to send
-            if j == my_index:
-                my_state.parts[my_index] = (
-                    vector[lo:hi].astype(np.float32) if weight > 0 else None,
-                    weight if weight > 0 else 0.0,
-                )
-                my_state.maybe_complete()
-                continue
-            payload = {
-                "round_id": round_id,
-                "sender": my_index,
-                "weight": weight if weight > 0 else 0.0,
-                "data": (
-                    serialize_array(vector[lo:hi], self.compression, checksum=True)
-                    if weight > 0
-                    else None
-                ),
-            }
-            if tele is not None and weight > 0:
-                # logical tensor bytes moved (pre-compression float32); the
-                # wire view lives in the frame-level net.bytes_* counters
-                tele.counter("allreduce.bytes_sent").inc((hi - lo) * 4)
-            sends.append(
-                self.client.call(
-                    endpoints[j], "avg.part", payload, timeout=self.timeout
-                )
-            )
-        await asyncio.gather(*sends)
+        out = np.empty(len(vector), np.float32)
+        # one chunk-bounds derivation per host, shared by the gather loop,
+        # the scatter build and the telemetry count below — these MUST agree
+        # (chunk indices are protocol state)
+        chunks_by_host = [
+            span_chunks(jlo, jhi, chunk_size) if jhi > jlo else []
+            for jlo, jhi in spans
+        ]
 
-        # 2) reduce my span once all expected parts arrive — or after the
-        # straggler window closes (arguments.py:23-28 semantics): reduce what
-        # we have; the missing sender simply doesn't contribute this round
-        if my_state is not None:
-            try:
-                await asyncio.wait_for(
-                    my_state.arrived.wait(), timeout=self.straggler_timeout
-                )
-            except asyncio.TimeoutError:
-                missing = (my_state.expected_senders or set()) - set(my_state.parts)
-                logger.warning(
-                    f"{round_id}: proceeding without stragglers {sorted(missing)}"
-                )
-                if tele is not None:
-                    tele.counter("allreduce.stragglers").inc(len(missing))
-                    tele.event(
-                        "allreduce.stragglers", round_id=round_id,
-                        missing=sorted(missing),
-                    )
-            total_w = sum(w for p, w in my_state.parts.values() if p is not None)
-            lo, hi = spans[my_index]
-            if total_w > 0:
-                acc = np.zeros(hi - lo, np.float32)
-                for part, w in my_state.parts.values():
-                    if part is not None and w > 0:
-                        native.axpy(acc, part, w)  # acc += w * part, in C++
-                reduced = native.scale(acc, 1.0 / total_w)
-            else:  # all-aux group: nothing to average
-                reduced = vector[lo:hi].astype(np.float32)
-            if not my_state.reduced.done():
-                my_state.reduced.set_result((reduced, total_w))
+        # the streaming all-gather is launched FIRST: every chunk request
+        # parks at its host and completes the moment that chunk reduces, so
+        # reduced chunks flow back while later chunks are still being
+        # scattered/reduced — this is where the pipeline wins its wall-clock
+        gather_start = time.perf_counter()
 
-        # 3) gather all reduced spans
-        async def fetch(j: int) -> np.ndarray:
-            lo, hi = spans[j]
-            if hi <= lo:
-                return np.zeros(0, np.float32)
-            if j == my_index:
-                return (await my_state.reduced)[0]
+        async def fetch_chunk(j: int, c: int, clo: int, chi: int) -> None:
+            t0 = time.perf_counter()
             reply = await self.client.call(
                 endpoints[j],
                 "avg.get_reduced",
-                {"round_id": round_id},
+                {"round_id": round_id, "chunk": c},
                 timeout=self.timeout,
             )
+            data = deserialize_array(reply["data"])
+            if data.size != chi - clo:
+                raise ValueError(
+                    f"chunk size mismatch: got {data.size}, want {chi - clo}"
+                )
+            np.copyto(out[clo:chi], data.reshape(-1), casting="unsafe")
             if tele is not None:
-                tele.counter("allreduce.bytes_received").inc((hi - lo) * 4)
-            return deserialize_array(reply["data"]).astype(np.float32)
+                raw = (chi - clo) * 4
+                tele.counter("allreduce.bytes_received").inc(raw)
+                tele.counter("allreduce.chunks_received").inc()
+                tele.counter("avg.bytes_saved").inc(
+                    max(0, raw - len(reply["data"]))
+                )
+                tele.histogram("allreduce.chunk_latency_s").observe(
+                    time.perf_counter() - t0
+                )
 
-        pieces = await asyncio.gather(*(fetch(j) for j in range(n)))
-        out = np.concatenate(pieces)
-        assert out.size == vector.size
+        async def fetch_own(c: int, clo: int, chi: int) -> None:
+            data = await asyncio.shield(my_state.chunk(c).done)
+            if self.compression is not CompressionType.NONE:
+                # adopt my own span THROUGH the wire codec: every other
+                # member decodes the lossy wire bytes, and synchronous-SGD
+                # emulation wants all replicas to apply bit-identical
+                # values — a host keeping its fp32 low bits would drift
+                # its params from the rest of the group every round
+                data = wire_roundtrip(data, self.compression)
+            np.copyto(out[clo:chi], data, casting="unsafe")
+
+        gathers = []
+        for j in range(n):
+            chunks = chunks_by_host[j]
+            if not chunks:
+                continue
+            if j == my_index:
+                gathers.extend(
+                    fetch_own(c, clo, chi)
+                    for c, (clo, chi) in enumerate(chunks)
+                )
+            else:
+                gathers.extend(
+                    fetch_chunk(j, c, clo, chi)
+                    for c, (clo, chi) in enumerate(chunks)
+                )
+        gather_task = asyncio.ensure_future(
+            asyncio.gather(*gathers)
+        )
+
+        try:
+            # scatter: send my slice of each host's span, chunk by chunk
+            # (zero-weight marker when I have no data, so hosts never wait
+            # on an aux peer). Remote sends are interleaved CHUNK-MAJOR —
+            # every host's chunk 0 before any host's chunk 1 — so each host
+            # can start reducing (and serving) its first chunks while the
+            # rest of the scatter is still on the wire; host-major order
+            # would starve the last host until the whole span drained.
+            per_host: List[List[Tuple[int, int, int, int]]] = []  # (j, c, lo, hi)
+            sends = []
+            for j in range(n):
+                jlo, jhi = spans[j]
+                if jhi <= jlo:
+                    continue  # client-mode host: nothing to send
+                if j == my_index:
+                    # self-delivery skips the RPC but NOT the codec: my own
+                    # contribution must suffer the identical quantization as
+                    # the copies other hosts receive, or (a) my hosted span
+                    # would mix full-precision self bits that no other
+                    # replica path models, and (b) the optimizer's error
+                    # feedback — which assumes EVERY contributed element was
+                    # wire-compressed — would re-inject a residual that was
+                    # never actually lost for my own span, a same-sign
+                    # drift added every round
+                    if weight > 0:
+                        for c, (clo, chi) in enumerate(my_state.chunk_bounds):
+                            part = my_state.local_span[clo - jlo : chi - jlo]
+                            lossy = (
+                                self.compression is not CompressionType.NONE
+                            )
+                            if lossy:
+                                part = wire_roundtrip(part, self.compression)
+                            # the roundtripped array is fresh (never a view
+                            # of local_span), so the accumulator may adopt
+                            # and scale it in place instead of copying again
+                            my_state.accumulate(c, part, weight, own=lossy)
+                            my_state.chunk(c).arrived.add(my_index)
+                    else:
+                        my_state.chunk(-1).arrived.add(my_index)
+                    my_state.maybe_finalize_all()
+                    continue
+                if weight <= 0:
+                    sends.append(
+                        self.client.call(
+                            endpoints[j], "avg.part",
+                            {
+                                "round_id": round_id, "sender": my_index,
+                                "weight": 0.0, "chunk": -1, "data": None,
+                            },
+                            timeout=self.timeout,
+                        )
+                    )
+                    continue
+                per_host.append([
+                    (j, c, clo, chi)
+                    for c, (clo, chi) in enumerate(chunks_by_host[j])
+                ])
+            async def send_chunk(j: int, c: int, clo: int, chi: int) -> None:
+                # encode INSIDE the send coroutine: each chunk's codec work
+                # is followed by a yield into the RPC await, so inbound
+                # parts keep reducing and the gather keeps draining between
+                # encodes — serializing the whole vector up front would
+                # block the loop for the full codec latency and hold every
+                # compressed payload in memory at once
+                payload = serialize_array(
+                    vector[clo:chi], self.compression, checksum=True
+                )
+                if tele is not None:
+                    raw = (chi - clo) * 4
+                    # logical tensor bytes moved (pre-compression fp32);
+                    # the frame-level wire view lives in net.bytes_*
+                    tele.counter("allreduce.bytes_sent").inc(raw)
+                    tele.counter("allreduce.chunks_sent").inc()
+                    tele.counter("avg.bytes_saved").inc(
+                        max(0, raw - len(payload))
+                    )
+                await self.client.call(
+                    endpoints[j], "avg.part",
+                    {
+                        "round_id": round_id, "sender": my_index,
+                        "weight": weight, "chunk": c, "data": payload,
+                    },
+                    timeout=self.timeout,
+                )
+
+            for row in range(max((len(h) for h in per_host), default=0)):
+                for host_chunks in per_host:
+                    if row >= len(host_chunks):
+                        continue
+                    j, c, clo, chi = host_chunks[row]
+                    sends.append(send_chunk(j, c, clo, chi))
+            await asyncio.gather(*sends)
+
+            # straggler window (arguments.py:23-28 semantics): once my own
+            # sends are out, give the remaining senders ``straggler_timeout``
+            # to deliver my span's chunks, then finalize with what arrived —
+            # a missing sender simply doesn't contribute this round
+            if my_state is not None:
+                pending = [
+                    my_state.chunk(c).done
+                    for c in range(len(my_state.chunk_bounds))
+                ]
+                try:
+                    if pending:
+                        await asyncio.wait_for(
+                            asyncio.shield(asyncio.gather(*pending)),
+                            timeout=self.straggler_timeout,
+                        )
+                except asyncio.TimeoutError:
+                    missing = my_state.missing_senders()
+                    logger.warning(
+                        f"{round_id}: proceeding without stragglers "
+                        f"{sorted(missing)}"
+                    )
+                    if tele is not None:
+                        tele.counter("allreduce.stragglers").inc(len(missing))
+                        tele.event(
+                            "allreduce.stragglers", round_id=round_id,
+                            missing=sorted(missing),
+                        )
+                    my_state.finalize_all()
+
+            await gather_task
+        except BaseException:
+            gather_task.cancel()
+            raise
+        if ctx is not None and isinstance(ctx, dict):
+            ctx["gather_wait_s"] = round(
+                time.perf_counter() - gather_start, 6
+            )
+            ctx["chunks"] = sum(len(c) for c in chunks_by_host)
         return out

@@ -18,13 +18,17 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from dedloc_tpu.averaging.allreduce import AllreduceFailed, GroupAllReduce
+from dedloc_tpu.averaging.allreduce import (
+    DEFAULT_CHUNK_SIZE,
+    AllreduceFailed,
+    GroupAllReduce,
+)
 from dedloc_tpu.averaging.matchmaking import (
     GroupInfo,
     Matchmaking,
     MatchmakingFailed,
 )
-from dedloc_tpu.averaging.partition import flatten_tree, unflatten_tree
+from dedloc_tpu.averaging.partition import TreeLayout
 from dedloc_tpu.core.serialization import (
     CompressionType,
     deserialize_tree,
@@ -65,6 +69,8 @@ class DecentralizedAverager:
         auxiliary: bool = False,
         allow_state_sharing: bool = True,
         compression: str | CompressionType = CompressionType.FLOAT16,
+        chunk_size: int = DEFAULT_CHUNK_SIZE,  # elements per wire chunk in
+        # the pipelined all-reduce; <= 0 restores monolithic spans
         averaging_expiration: float = 5.0,
         averaging_timeout: float = 30.0,
         target_group_size: int = 256,
@@ -108,6 +114,11 @@ class DecentralizedAverager:
             if isinstance(compression, str)
             else compression
         )
+        self.chunk_size = int(chunk_size)
+        # zero-copy flatten: the tree schema is stable across rounds, so ONE
+        # TreeLayout (with its preallocated flat buffer) serves every round;
+        # rebuilt only if the schema ever changes
+        self._layout: Optional[TreeLayout] = None
         self.averaging_expiration = averaging_expiration
         self.averaging_timeout = averaging_timeout
         self.target_group_size = target_group_size
@@ -329,6 +340,7 @@ class DecentralizedAverager:
                     compression=self.compression,
                     timeout=averaging_timeout,
                     straggler_timeout=averaging_expiration,
+                    chunk_size=self.chunk_size,
                     telemetry_registry=self.telemetry,
                 )
                 self.matchmaking = Matchmaking(
@@ -344,6 +356,7 @@ class DecentralizedAverager:
                     authorizer=authorizer,
                     authority_public_key=authority_public_key,
                     aux=auxiliary,
+                    chunk_size=self.chunk_size,
                     telemetry_registry=self.telemetry,
                 )
 
@@ -435,7 +448,12 @@ class DecentralizedAverager:
         self.last_contributors = group.contributors
         if len(group.members) == 1:
             return (tree if weight > 0 else None), 1
-        flat, spec = flatten_tree(tree)
+        if self._layout is None or not self._layout.matches(tree):
+            self._layout = TreeLayout.for_tree(tree)
+        # flatten into the layout's reused buffer: no astype/concatenate
+        # temporaries on the hot path (valid until the next round's flatten —
+        # the all-reduce reads it only within run())
+        flat = self._layout.flatten_into(tree)
         try:
             # the nonce is fresh per group assembly, so a retried round never
             # collides with _RoundState left over from a failed attempt
@@ -446,11 +464,15 @@ class DecentralizedAverager:
                 weight,
                 group.endpoints,
                 group.bandwidths,
+                # chunk geometry must be identical on every member: use the
+                # group-negotiated size (min of advertised; 0 = monolithic
+                # if any member can't chunk), never the local config alone
+                chunk_size=group.chunk_size,
             )
         except AllreduceFailed as e:
             logger.warning(f"allreduce failed for {round_id}: {e}")
             return None, len(group.members)
-        return unflatten_tree(averaged, spec), len(group.members)
+        return self._layout.unflatten(averaged), len(group.members)
 
     # --------------------------------------------------------- state sharing
 

@@ -13,27 +13,99 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 
+class TreeLayout:
+    """Precomputed flat layout for a stable {name: array} schema.
+
+    The averaging hot path flattens the identical tree schema every round;
+    re-deriving the spec and allocating ``astype`` + ``concatenate``
+    intermediates per round costs one full extra copy of the gradient
+    vector. A TreeLayout is built once from the first round's tree and then
+    ``flatten_into`` writes each tensor straight into ONE preallocated flat
+    buffer (the dtype cast happens during the strided copy, no temporary).
+    """
+
+    __slots__ = ("spec", "offsets", "total_size", "_buffer")
+
+    def __init__(self, spec: Sequence[Tuple[str, Tuple[int, ...], np.dtype]]):
+        self.spec = list(spec)
+        self.offsets: List[int] = []
+        offset = 0
+        for _name, shape, _dtype in self.spec:
+            self.offsets.append(offset)
+            offset += int(np.prod(shape)) if shape else 1
+        self.total_size = offset
+        self._buffer: Optional[np.ndarray] = None
+
+    @classmethod
+    def for_tree(cls, tree: Dict[str, np.ndarray]) -> "TreeLayout":
+        spec = []
+        for name in sorted(tree):
+            arr = np.asarray(tree[name])
+            spec.append((name, arr.shape, arr.dtype))
+        return cls(spec)
+
+    def matches(self, tree: Dict[str, np.ndarray]) -> bool:
+        if len(tree) != len(self.spec):
+            return False
+        for name, shape, dtype in self.spec:
+            arr = tree.get(name)
+            if arr is None:
+                return False
+            arr = np.asarray(arr)
+            if arr.shape != shape or arr.dtype != dtype:
+                return False
+        return True
+
+    def flatten_into(
+        self, tree: Dict[str, np.ndarray], out: Optional[np.ndarray] = None
+    ) -> np.ndarray:
+        """Write ``tree`` into a flat fp32 vector. ``out=None`` reuses (and
+        lazily allocates) the layout's own buffer — callers that hold the
+        layout across rounds get a zero-allocation flatten. The returned
+        vector is only valid until the next ``flatten_into`` on the same
+        buffer."""
+        if out is None:
+            if self._buffer is None:
+                self._buffer = np.empty((self.total_size,), np.float32)
+            out = self._buffer
+        assert out.size == self.total_size, "buffer does not match layout"
+        for (name, shape, _dtype), offset in zip(self.spec, self.offsets):
+            arr = np.asarray(tree[name])
+            size = int(np.prod(shape)) if shape else 1
+            # the cast (if any) happens inside the copy — no astype temp
+            np.copyto(
+                out[offset : offset + size],
+                arr.reshape(-1),
+                casting="unsafe",
+            )
+        return out
+
+    def unflatten(self, flat: np.ndarray) -> Dict[str, np.ndarray]:
+        return unflatten_tree(flat, self.spec)
+
+
 def flatten_tree(tree: Dict[str, np.ndarray]) -> Tuple[np.ndarray, List[Tuple[str, Tuple[int, ...], np.dtype]]]:
     """Flatten {name: array} into one fp32 vector + layout spec (sorted by name
-    so every peer produces the identical layout)."""
-    spec = []
-    chunks = []
-    for name in sorted(tree):
-        arr = np.asarray(tree[name])
-        spec.append((name, arr.shape, arr.dtype))
-        chunks.append(arr.astype(np.float32).ravel())
-    flat = np.concatenate(chunks) if chunks else np.zeros((0,), np.float32)
-    return flat, spec
+    so every peer produces the identical layout). One-shot convenience over
+    ``TreeLayout`` — round-loop callers should hold a TreeLayout instead and
+    reuse its buffer."""
+    layout = TreeLayout.for_tree(tree)
+    return layout.flatten_into(tree, np.empty((layout.total_size,), np.float32)), layout.spec
 
 
 def unflatten_tree(
     flat: np.ndarray, spec: Sequence[Tuple[str, Tuple[int, ...], np.dtype]]
 ) -> Dict[str, np.ndarray]:
+    """Inverse of ``flatten_tree``. When a tensor's target dtype is already
+    the vector's dtype the returned array is a reshaped VIEW of ``flat``
+    (the old unconditional ``astype`` copied every fp32 tensor twice per
+    round); callers that mutate the result in place must copy first."""
     out = {}
     offset = 0
     for name, shape, dtype in spec:
         size = int(np.prod(shape)) if shape else 1
-        out[name] = flat[offset : offset + size].reshape(shape).astype(dtype)
+        chunk = flat[offset : offset + size].reshape(shape)
+        out[name] = chunk if chunk.dtype == dtype else chunk.astype(dtype)
         offset += size
     assert offset == flat.size, "layout spec does not match vector length"
     return out

@@ -28,7 +28,9 @@ import jax
 import numpy as np
 import optax
 
+from dedloc_tpu.averaging.allreduce import DEFAULT_CHUNK_SIZE
 from dedloc_tpu.averaging.averager import DecentralizedAverager
+from dedloc_tpu.collaborative.error_feedback import ErrorFeedback
 from dedloc_tpu.collaborative.progress import (
     CollaborationState,
     LocalProgress,
@@ -125,6 +127,22 @@ class CollaborativeOptimizer:
         # (contributes weight 0, still receives the group average)
         state_sync_retries: int = 2,  # bounded state-download retry with
         state_sync_backoff: float = 0.5,  # exponential backoff (averager)
+        chunk_size: int = DEFAULT_CHUNK_SIZE,  # elements per wire chunk in
+        # the pipelined all-reduce; <= 0 restores monolithic spans (the
+        # pre-pipeline wire format) — same contract as --averager.chunk_size
+        error_feedback: bool = True,  # residual error feedback for lossy
+        # wire compression: the previous round's quantization error is added
+        # back into the next round's contribution, so float16/uint8 wire
+        # formats don't bias the trunk (collaborative/error_feedback.py).
+        # No-op under compression="none".
+        overlap_averaging: bool = False,  # opt-in background averaging: at
+        # a round boundary the averaging round is launched on the executor
+        # and the trainer KEEPS ACCUMULATING the next microbatches; the
+        # averaged update is applied when the round lands — one boundary
+        # late (bounded staleness). Auto-disabled during the contribution
+        # ramp, while health-gated, and around state sync; a failed
+        # overlapped round restores its gradients into the accumulator and
+        # falls back to the synchronous path (docs/fleet.md).
         telemetry_registry=None,  # per-peer telemetry scope, forwarded to
         # the averager/matchmaking/RPC stack (telemetry/registry.py); None
         # falls back to the process-global registry at each site
@@ -148,6 +166,24 @@ class CollaborativeOptimizer:
         self._rounds_since_join = 0
         self._last_loss: Optional[float] = None
         self.telemetry = telemetry_registry
+        self.overlap_averaging = bool(overlap_averaging)
+        # in-flight overlapped round: {future, named, commit, collab,
+        # samples, n_micro, partners_certain} — at most ONE at a time
+        self._overlap_inflight: Optional[Dict[str, Any]] = None
+        # after a failed overlapped round the next boundary runs the
+        # synchronous path (and its retry/resync ladder); a successful
+        # global step re-arms overlap
+        self._overlap_cooldown = False
+        # samples committed to the in-flight round: still advertised in
+        # progress reports until the round lands — zeroing the advertised
+        # count at an unchanged step would deflate the collaboration-wide
+        # sum and flip partners' ready_for_step back off (the sync path
+        # keeps its full count published throughout averaging and resets
+        # only together with the step advance)
+        self._overlap_committed_samples = 0
+        self.error_feedback = ErrorFeedback(
+            compression if error_feedback else "none"
+        )
 
         self.averager = DecentralizedAverager(
             dht,
@@ -157,6 +193,7 @@ class CollaborativeOptimizer:
             auxiliary=auxiliary,
             allow_state_sharing=allow_state_sharing and not auxiliary,
             compression=compression,
+            chunk_size=chunk_size,
             averaging_expiration=averaging_expiration,
             averaging_timeout=averaging_timeout,
             target_group_size=target_group_size,
@@ -274,6 +311,22 @@ class CollaborativeOptimizer:
                 self.performance_ema.resume()
                 self._ema_started = True
 
+            if self._overlap_inflight is not None:
+                if not self._overlap_inflight["future"].done():
+                    # a background round is in flight: keep accumulating —
+                    # its result applies one boundary late (the overlap
+                    # staleness contract, docs/fleet.md). Catch-up/ramp
+                    # decisions wait until the round lands.
+                    self._report(synced=True)
+                    return state, grad_acc, n_acc, False
+                state, grad_acc, n_acc, stepped, applied = (
+                    self._harvest_overlap(state, grad_acc, n_acc)
+                )
+                if applied:
+                    return state, grad_acc, n_acc, stepped
+                # failed overlapped round: its gradients were restored into
+                # the accumulator — fall through to the synchronous path
+
             collab = self.tracker.fetch_collaboration_state()
             gap = collab.optimizer_step - self.local_step
             if (
@@ -326,7 +379,12 @@ class CollaborativeOptimizer:
         self.tracker.report_local_progress(
             LocalProgress(
                 step=self.local_step,
-                samples_accumulated=self.local_samples_accumulated,
+                # flight-committed samples stay advertised at this step:
+                # they are real contribution to the round in progress
+                samples_accumulated=(
+                    self.local_samples_accumulated
+                    + self._overlap_committed_samples
+                ),
                 samples_per_second=self.performance_ema.samples_per_second,
                 time=get_dht_time(),
                 client_mode=self.client_mode,
@@ -506,6 +564,20 @@ class CollaborativeOptimizer:
         named = _tree_to_named(mean_grads)  # device_get of the full grad tree
         self.seam_ms["grads_device_get"] = (time.perf_counter() - t0) * 1e3
 
+        # error feedback (collaborative/error_feedback.py): fold the last
+        # round's quantization residual into this round's contribution so a
+        # lossy wire format doesn't bias the trunk. Committed only when the
+        # round actually lands — a retried round re-derives the same
+        # contribution instead of compounding the residual.
+        if weight_scale > 0 and self.error_feedback.enabled:
+            contrib, ef_commit = self.error_feedback.prepare(named)
+            if tele is not None:
+                tele.gauge("opt.ef_residual_norm").set(
+                    self.error_feedback.residual_norm()
+                )
+        else:
+            contrib, ef_commit = named, None
+
         # partners CERTAIN to be joinable (reported exactly our step) get
         # the full straggler window; partners merely NEAR (one behind —
         # usually a just-applied record that hasn't refreshed, possibly a
@@ -518,10 +590,23 @@ class CollaborativeOptimizer:
             self.averager.averaging_expiration,
             max(2.0, 2.0 * self.tracker.default_refresh_period),
         )
+        expected_size = (
+            collab.num_peers_near_step + collab.num_aux
+            if collab.num_peers_near_step >= 2 else None
+        )
+        window = None if partners_certain else near_grace
+
+        if self._overlap_allowed(weight_scale):
+            return self._launch_overlap(
+                state, named, contrib, ef_commit, collab,
+                weight_scale, expected_size, window, partners_certain,
+                n_micro=n,
+            )
+
         self.performance_ema.pause()
         try:
             averaged, group_size = self.averager.step(
-                named,
+                contrib,
                 weight=float(self.local_samples_accumulated) * weight_scale,
                 round_id=round_id,
                 # tracker's live peer count: full group => assemble the
@@ -535,11 +620,8 @@ class CollaborativeOptimizer:
                 # can still pair with us — the design the solo-grace path
                 # above depends on. Only near-step trainers are counted —
                 # lagging peers are resyncing and must not size the group.
-                expected_size=(
-                    collab.num_peers_near_step + collab.num_aux
-                    if collab.num_peers_near_step >= 2 else None
-                ),
-                window=None if partners_certain else near_grace,
+                expected_size=expected_size,
+                window=window,
             )
             contributors = getattr(
                 self.averager, "last_contributors", group_size
@@ -559,6 +641,8 @@ class CollaborativeOptimizer:
             if averaged is not None:
                 mean_grads = _named_to_tree(averaged, mean_grads)
                 self._round_failures = 0
+                if ef_commit is not None:
+                    self._settle_error_feedback(ef_commit, group_size)
             elif partners_certain:
                 self._round_failures += 1
                 if self._round_failures <= self.max_round_retries:
@@ -591,10 +675,173 @@ class CollaborativeOptimizer:
         finally:
             self.performance_ema.resume()
 
+    def _settle_error_feedback(self, ef_commit, group_size: int) -> None:
+        """A round whose result we adopted settles the pending residual.
+
+        ``group_size > 1``: the contribution crossed the lossy wire — adopt
+        this round's quantization error as the next residual. A SINGLETON
+        round never touches the wire: the averager hands the contribution
+        tree back verbatim, so grad + residual was applied at FULL
+        precision — the carried residual is consumed, and committing the
+        phantom wire error here would re-inject it next round (the exact
+        bias error feedback exists to remove)."""
+        if group_size > 1:
+            ef_commit()
+        else:
+            self.error_feedback.reset()
+
+    # ------------------------------------------------- background averaging
+
+    def _overlap_allowed(self, weight_scale: float) -> bool:
+        """Overlap mode launches a background round only when the peer is a
+        full-standing contributor: never during the contribution ramp (a
+        joiner's weight schedule must advance one observed round at a time),
+        never while health-gated (a gated round's result decides whether the
+        local grads are even safe to keep), never while desynced or cooling
+        down from a failed overlapped round — those boundaries take the
+        synchronous path with its retry/resync ladder."""
+        return (
+            self.overlap_averaging
+            and not self._overlap_cooldown
+            and not self.auxiliary
+            and not self._desynced
+            and weight_scale > 0.0  # trunk-health gate engaged => sync path
+            and self._rounds_since_join >= self.ramp_rounds  # ramp finished
+        )
+
+    def _launch_overlap(
+        self, state: TrainState, named, contrib, ef_commit, collab,
+        weight_scale, expected_size, window, partners_certain, n_micro,
+    ):
+        """Start the averaging round on the DHT executor and hand control
+        straight back to the trainer: the next accumulation phase overlaps
+        matchmaking + the full wire round. The contributed samples are
+        committed to the in-flight round (accumulators reset); the averaged
+        update lands at a later boundary — one boundary of staleness, by
+        contract."""
+        round_id = f"step{collab.optimizer_step}"
+        fut = self.averager.step(
+            contrib,
+            weight=float(self.local_samples_accumulated) * weight_scale,
+            round_id=round_id,
+            return_future=True,
+            expected_size=expected_size,
+            window=window,
+        )
+        self._overlap_inflight = {
+            "future": fut,
+            "named": named,  # pre-error-feedback grads, for failure restore
+            "commit": ef_commit,
+            "collab": collab,
+            "samples": self.local_samples_accumulated,
+            "n_micro": int(n_micro),
+            "partners_certain": partners_certain,
+        }
+        tele = telemetry.resolve(self.telemetry)
+        if tele is not None:
+            tele.counter("opt.overlap_launched").inc()
+            tele.event(
+                "opt.overlap_launched", round_id=round_id,
+                samples=self.local_samples_accumulated,
+            )
+        if self.verbose:
+            logger.info(
+                f"{round_id}: averaging launched in background "
+                f"({self.local_samples_accumulated} samples committed)"
+            )
+        self._overlap_committed_samples = self.local_samples_accumulated
+        self.local_samples_accumulated = 0
+        return (
+            state,
+            zeros_like_grads(state.params),
+            jax.numpy.zeros([], jax.numpy.int32),
+            False,
+        )
+
+    def _harvest_overlap(self, state: TrainState, grad_acc, n_acc):
+        """The in-flight round resolved. On success, apply its averaged
+        update (one boundary late) while PRESERVING the microbatches
+        accumulated during the flight. On failure, restore the committed
+        gradients into the live accumulator and let this boundary take the
+        synchronous path. Returns (state, grad_acc, n_acc, stepped,
+        applied)."""
+        inflight, self._overlap_inflight = self._overlap_inflight, None
+        # the flight resolved either way: on success the step advances (the
+        # committed samples were consumed by the applied round), on failure
+        # they are restored into the live accumulator below — keeping the
+        # committed count advertised past this point would double-count
+        self._overlap_committed_samples = 0
+        collab = inflight["collab"]
+        round_id = f"step{collab.optimizer_step}"
+        tele = telemetry.resolve(self.telemetry)
+        try:
+            averaged, group_size = inflight["future"].result()
+        except Exception as e:  # noqa: BLE001 — a failed round costs one
+            # round, never the training process (AllreduceFailed is already
+            # folded into None by the averager; this guards executor deaths)
+            logger.warning(f"{round_id}: overlapped round raised {e!r}")
+            averaged, group_size = None, 1
+        contributors = getattr(self.averager, "last_contributors", group_size)
+        if (averaged is not None and contributors <= 1
+                and inflight["partners_certain"]):
+            # same replica-divergence guard as the synchronous path: known
+            # partners may have averaged without us — do not apply solo
+            averaged = None
+        template = zeros_like_grads(state.params)
+        if averaged is not None:
+            try:
+                mean_grads = _named_to_tree(averaged, template)
+            except (KeyError, ValueError) as e:
+                logger.warning(f"{round_id}: overlap result rejected: {e!r}")
+                averaged = None
+        if averaged is not None:
+            # a landed round clears the retry ladder, same as the
+            # synchronous success path — otherwise stale failure counts
+            # survive overlap successes and a later transient failure
+            # skips straight to local-apply + resync
+            self._round_failures = 0
+            if inflight["commit"] is not None:
+                self._settle_error_feedback(inflight["commit"], group_size)
+            if tele is not None:
+                tele.counter("opt.overlap_applied").inc()
+                tele.event(
+                    "opt.overlap_applied", round_id=round_id,
+                    group_size=group_size,
+                    accumulated_during_flight=self.local_samples_accumulated,
+                )
+            result = self._apply_and_advance(
+                state, mean_grads, collab, group_size,
+                keep_acc=(grad_acc, n_acc),
+            )
+            return (*result, True)
+        # failure: fold the committed gradients back into the accumulator
+        # (mean * n_micro reconstructs the sum) and fall back to the
+        # synchronous path — cooldown until a global step succeeds
+        self._overlap_cooldown = True
+        if tele is not None:
+            tele.counter("opt.overlap_failed").inc()
+            tele.event("opt.overlap_failed", round_id=round_id)
+        if self.verbose:
+            logger.warning(
+                f"{round_id}: overlapped round failed — restoring grads, "
+                "falling back to synchronous averaging"
+            )
+        restored = _named_to_tree(inflight["named"], template)
+        n_micro = inflight["n_micro"]
+        grad_acc = jax.tree.map(
+            lambda a, m: a + m * n_micro, grad_acc, restored
+        )
+        n_acc = n_acc + n_micro
+        self.local_samples_accumulated += inflight["samples"]
+        return state, grad_acc, n_acc, False, False
+
     def _apply_and_advance(self, state: TrainState, mean_grads, collab,
-                           group_size: int):
+                           group_size: int, keep_acc=None):
         """Optimizer apply + NaN guard + backup + progress bookkeeping —
-        the tail of a global step, shared by the solo and networked paths."""
+        the tail of a global step, shared by the solo, networked and
+        overlap-harvest paths. ``keep_acc=(grad_acc, n_acc)`` preserves the
+        accumulation that ran while an overlapped round was in flight
+        (those microbatches belong to the NEXT round)."""
         round_id = f"step{collab.optimizer_step}"
         t0 = time.perf_counter()
         # NaN-rollback backup stays ON DEVICE: an HBM copy of the pre-apply
@@ -625,7 +872,9 @@ class CollaborativeOptimizer:
             )
         self.local_step = collab.optimizer_step + 1
         self._rounds_since_join += 1  # advances the contribution ramp
-        self.local_samples_accumulated = 0
+        self._overlap_cooldown = False  # a landed step re-arms overlap
+        if keep_acc is None:
+            self.local_samples_accumulated = 0
         self._backup_and_share(new_state)
         self._report(synced=True)
         self.tracker.fetch_collaboration_state(force=True)
@@ -634,6 +883,10 @@ class CollaborativeOptimizer:
                 f"global step {self.local_step} applied "
                 f"(group={group_size}, samples~{collab.samples_accumulated})"
             )
+        if keep_acc is not None:
+            # overlap harvest: the microbatches accumulated during the
+            # flight stay live — they are the next round's contribution
+            return new_state, keep_acc[0], keep_acc[1], True
         return (
             new_state,
             zeros_like_grads(new_state.params),
@@ -790,6 +1043,10 @@ class CollaborativeOptimizer:
         return new_state
 
     def _catch_up(self, state: TrainState, collab) -> TrainState:
+        # the carried quantization residual belongs to gradients computed on
+        # params we are about to replace — feeding it forward would inject
+        # stale signal into the first post-resync round
+        self.error_feedback.reset()
         new_state = self.load_state_from_peers(state)
         # even if nobody shares state, adopt the global step counter so we
         # rejoin the current round instead of contesting old ones
@@ -888,5 +1145,9 @@ class CollaborativeOptimizer:
         return ok
 
     def shutdown(self) -> None:
+        inflight = self._overlap_inflight
+        if inflight is not None:
+            inflight["future"].cancel()
+            self._overlap_inflight = None
         self._join_backup()
         self.averager.shutdown()

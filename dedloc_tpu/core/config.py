@@ -167,7 +167,16 @@ class AveragerArguments:
     performance_ema_alpha: float = 0.1
     target_group_size: int = 256
     metadata_expiration: float = 30.0
-    compression: str = "float16"  # none | float16 | uint8
+    compression: str = "float16"  # none | float16 | uint8 — wire format for
+    # averaging rounds (core/serialization.py; the native F16C codec when
+    # loaded). Lossy formats pair with the optimizer's error feedback so the
+    # quantization residual never biases the trunk (docs/fleet.md).
+    # elements per wire chunk in the pipelined all-reduce: spans are split
+    # into fixed-size chunks so hosts reduce (and the all-gather streams
+    # back) each chunk as it arrives instead of stalling on monolithic
+    # spans. <= 0 restores the monolithic-span wire format. Default 128Ki
+    # fp32 elements = 512 KiB raw per message.
+    chunk_size: int = 131072
     bandwidth: float = 1000.0  # advertised Mbps, for weighted partitioning
     # fixed port for the averager's own RPC server (0 = ephemeral). A
     # listening averager doubles as a circuit relay, so give PUBLIC peers a
@@ -227,6 +236,19 @@ class CollaborativeOptimizerArguments:
     # suspect gradients locally either: with no group average received it
     # drops them and resyncs state.
     health_gate_loss_ratio: float = 0.0
+    # residual error feedback for lossy wire compression (on by default;
+    # no-op under --averager.compression none): each round's quantization
+    # error is added back into the next round's contribution, keeping the
+    # averaged trunk unbiased under float16/uint8 wire formats
+    # (collaborative/error_feedback.py, docs/fleet.md)
+    error_feedback: bool = True
+    # opt-in background averaging: launch the averaging round at the
+    # boundary and keep accumulating the next microbatches; the averaged
+    # update applies when the round lands — ONE boundary late (bounded
+    # staleness). Auto-disables during the contribution ramp, while
+    # health-gated, and around state sync; a failed overlapped round falls
+    # back to synchronous averaging (docs/fleet.md staleness contract).
+    overlap_averaging: bool = False
 
 
 @dataclass

@@ -76,6 +76,24 @@ def deserialize_array(data: bytes) -> np.ndarray:
     raise ValueError(f"unknown compression {compression}")  # pragma: no cover
 
 
+def wire_roundtrip(
+    x: np.ndarray, compression: CompressionType
+) -> np.ndarray:
+    """What the receiving side of the wire reconstructs for ``x`` — encode
+    then decode, skipping the msgpack framing. Used by the optimizer's
+    error-feedback residual to measure this round's quantization error
+    without touching the network."""
+    x = np.asarray(x, dtype=np.float32)
+    if compression is CompressionType.NONE:
+        return x
+    if compression is CompressionType.FLOAT16:
+        return native.f16_to_f32(native.f32_to_f16(x))
+    if compression is CompressionType.UINT8:
+        q, lo, scale = native.quantize_uint8(x)
+        return native.dequantize_uint8(q, lo, scale).reshape(x.shape)
+    raise ValueError(f"unknown compression {compression}")  # pragma: no cover
+
+
 def serialize_tree(
     tree: Dict[str, np.ndarray],
     compression: CompressionType = CompressionType.NONE,

@@ -20,6 +20,7 @@ carries handshakes, not tensor bytes.
 from __future__ import annotations
 
 import asyncio
+import socket
 import struct
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
@@ -57,6 +58,20 @@ def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
 
 
 Handler = Callable[[Endpoint, Dict[str, Any]], Awaitable[Any]]
+
+
+def _set_nodelay(writer: asyncio.StreamWriter) -> None:
+    """Disable Nagle on an RPC connection. The averaging wire path streams
+    many mid-sized chunk frames in a request/reply pattern; with Nagle on,
+    each frame can sit in the kernel waiting for the previous frame's ACK
+    (up to a delayed-ACK period), which serializes the pipelined all-reduce
+    on exactly the latency it exists to hide."""
+    sock = writer.get_extra_info("socket")
+    if sock is not None:
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:  # pragma: no cover — non-TCP transports
+            pass
 
 
 def relay_endpoint(relay: Endpoint, peer_id: bytes) -> Endpoint:
@@ -161,6 +176,7 @@ class RPCServer:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         peer = writer.get_extra_info("peername") or ("?", 0)
+        _set_nodelay(writer)
         self._writers.add(writer)
         try:
             while True:
@@ -255,6 +271,7 @@ class RPCClient:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(*endpoint), timeout=self.request_timeout
             )
+            _set_nodelay(writer)
             self._conns[endpoint] = (reader, writer)
             self._pending[endpoint] = {}
             self._readers[endpoint] = asyncio.ensure_future(

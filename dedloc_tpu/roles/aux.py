@@ -85,6 +85,7 @@ def run_aux(
         batch_size_lead=args.optimizer.batch_size_lead,
         bandwidth=args.averager.bandwidth,
         compression=args.averager.compression,
+        chunk_size=args.averager.chunk_size,
         target_group_size=args.averager.target_group_size,
         averaging_expiration=args.averager.averaging_expiration,
         averaging_timeout=args.averager.averaging_timeout,

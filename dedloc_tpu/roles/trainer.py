@@ -237,6 +237,9 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         batch_size_lead=args.optimizer.batch_size_lead,
         bandwidth=args.averager.bandwidth,
         compression=args.averager.compression,
+        chunk_size=args.averager.chunk_size,
+        error_feedback=args.optimizer.error_feedback,
+        overlap_averaging=args.optimizer.overlap_averaging,
         target_group_size=args.averager.target_group_size,
         averaging_expiration=args.averager.averaging_expiration,
         averaging_timeout=args.averager.averaging_timeout,

@@ -7,7 +7,11 @@ import threading
 import numpy as np
 import pytest
 
-from dedloc_tpu.averaging.allreduce import AllreduceFailed, GroupAllReduce
+from dedloc_tpu.averaging.allreduce import (
+    DEFAULT_CHUNK_SIZE,
+    AllreduceFailed,
+    GroupAllReduce,
+)
 from dedloc_tpu.averaging.matchmaking import Matchmaking, MatchmakingFailed, Member
 from dedloc_tpu.averaging.partition import (
     flatten_tree,
@@ -75,8 +79,13 @@ def test_flatten_unflatten_roundtrip(rng):
 
 
 async def _allreduce_swarm(vectors, weights, bandwidths, client_mask=None,
-                           compression=CompressionType.NONE):
-    """Run a full group all-reduce among n in-process peers; returns results."""
+                           compression=CompressionType.NONE,
+                           chunk_size=DEFAULT_CHUNK_SIZE, dead=(),
+                           straggler_timeout=5.0):
+    """Run a full group all-reduce among n in-process peers over loopback
+    RPC; returns results. ``dead`` members never run (straggler scenarios —
+    pass a short ``straggler_timeout`` to keep those tests fast). Shared
+    with tests/test_wirepath.py — the one swarm harness for the wire path."""
     n = len(vectors)
     client_mask = client_mask or [False] * n
     servers, clients, reducers, endpoints = [], [], [], []
@@ -89,7 +98,9 @@ async def _allreduce_swarm(vectors, weights, bandwidths, client_mask=None,
         clients.append(client)
         servers.append(server)
         reducers.append(GroupAllReduce(client, server, compression=compression,
-                                       timeout=10.0))
+                                       timeout=10.0,
+                                       straggler_timeout=straggler_timeout,
+                                       chunk_size=chunk_size))
         endpoints.append(("127.0.0.1", server.port) if server else None)
     eff_bw = [0.0 if client_mask[i] else bandwidths[i] for i in range(n)]
     try:
@@ -98,6 +109,7 @@ async def _allreduce_swarm(vectors, weights, bandwidths, client_mask=None,
                 reducers[i].run("round1", i, vectors[i], weights[i], endpoints,
                                 eff_bw)
                 for i in range(n)
+                if i not in dead
             )
         )
         return results

@@ -164,6 +164,38 @@ def print_health(rows):
         elif event == "opt.grads_dropped":
             stats["dropped"] += 1
 
+    # wire-path attribution (pipelined all-reduce, docs/observability.md):
+    # every hosting member's allreduce.round span carries reduce_s (CPU time
+    # in the eager per-chunk reduce) and gather_wait_s (wall from gather
+    # launch to the last reduced chunk landing) — a slow round whose
+    # gather_wait dwarfs reduce_s is wire-bound, the reverse is CPU-bound
+    wire_rounds = [r for r in rows if r["event"] == "allreduce.round"
+                   and ("reduce_s" in r or "gather_wait_s" in r)]
+    if wire_rounds:
+        per_peer_wire = {}
+        for r in wire_rounds:
+            acc = per_peer_wire.setdefault(
+                r.get("peer", "?"),
+                {"rounds": 0, "dur": 0.0, "reduce": 0.0, "gather": 0.0,
+                 "chunks": 0},
+            )
+            acc["rounds"] += 1
+            acc["dur"] += float(r.get("dur_s", 0.0))
+            acc["reduce"] += float(r.get("reduce_s", 0.0))
+            acc["gather"] += float(r.get("gather_wait_s", 0.0))
+            acc["chunks"] += int(r.get("chunks", 0))
+        print("\nwire path (mean per all-reduce round):")
+        print("| peer | rounds | dur | reduce | gather wait | chunks |")
+        print("|---|---|---|---|---|---|")
+        for peer in sorted(per_peer_wire):
+            a = per_peer_wire[peer]
+            k = a["rounds"]
+            print(
+                f"| {peer} | {k} | {a['dur'] / k:.3f}s |"
+                f" {a['reduce'] / k:.3f}s | {a['gather'] / k:.3f}s |"
+                f" {a['chunks'] / k:.1f} |"
+            )
+
     print("\n| peer | events | faults | sync retries | checksum fails |"
           " rpc failures | join failures | grads dropped |")
     print("|---|---|---|---|---|---|---|---|")
