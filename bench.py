@@ -300,6 +300,186 @@ def run_allreduce_pipeline() -> None:
     }))
 
 
+def run_checkpoint_restore() -> None:
+    """Swarm-checkpoint restore bench (DEDLOC_BENCH=checkpoint_restore):
+    bootstrap bytes + wall for a joiner restoring the collaboration state,
+    1-provider monolithic blob vs N-provider sharded
+    (dedloc_tpu/checkpointing) — the availability cliff this subsystem
+    removes: the blob path downloads everything from ONE peer's uplink,
+    the sharded path spreads distinct shards across every announcing
+    provider.
+
+    Link model: per-provider serialized uplink (fixed per-message latency +
+    bandwidth-proportional transmission), the same volunteer-link shape as
+    the allreduce_pipeline bench; DEDLOC_BENCH_TIMING=0 skips the link-sim
+    sleeps and reports only the deterministic byte/provider accounting
+    (tier-1's contract half). vs_baseline is monolithic wall / sharded wall
+    on the same link — ~N for N equal providers.
+    """
+    import asyncio
+    import hashlib
+
+    import numpy as np
+
+    from dedloc_tpu.checkpointing import (
+        CheckpointAnnouncement,
+        build_manifest,
+        shard_bytes,
+        sharded_restore,
+    )
+    from dedloc_tpu.core.serialization import (
+        CompressionType,
+        serialize_array,
+        serialize_tree,
+    )
+    from dedloc_tpu.dht.protocol import RPCClient, RPCServer
+
+    tiny = os.environ.get("DEDLOC_BENCH_TINY", "") == "1"
+    timing = os.environ.get("DEDLOC_BENCH_TIMING", "1") != "0"
+    n_providers = int(os.environ.get("DEDLOC_BENCH_PROVIDERS", "4"))
+    if tiny:
+        dim, shard_elems = 262_144, 32_768  # 1 MB fp32, 8 shards
+        bandwidth, latency = 8e6, 0.3e-3
+    else:
+        dim, shard_elems = 8_388_608, 1_048_576  # 32 MB fp32, 8 shards
+        bandwidth, latency = 25e6, 1e-3
+
+    rng = np.random.default_rng(0)
+    tree = {"flat/params": rng.standard_normal(dim).astype(np.float32)}
+    metadata = {"step": 1000, "local_step": 1000}
+    manifest, flat = build_manifest(tree, 1000, shard_size=shard_elems,
+                                    metadata=metadata)
+    blob = serialize_tree(tree, CompressionType.NONE)
+    blob_digest = hashlib.sha256(blob).digest()
+
+    class LinkSim:
+        """One serialized uplink per provider (allreduce_pipeline's model)."""
+
+        def __init__(self, n):
+            self.locks = [asyncio.Lock() for _ in range(n)]
+
+        async def transmit(self, provider, nbytes):
+            async with self.locks[provider]:
+                await asyncio.sleep(latency + nbytes / bandwidth)
+
+    class MeteredClient(RPCClient):
+        """Counts restore wire bytes; reply payloads ride the serving
+        provider's simulated uplink."""
+
+        def __init__(self, port_to_provider, wire, link=None):
+            super().__init__(request_timeout=120.0)
+            self._port_to_provider = port_to_provider
+            self._wire = wire
+            self._link = link
+
+        async def call(self, endpoint, method, args=None, timeout=None):
+            reply = await super().call(endpoint, method, args, timeout)
+            payload = None
+            if method == "ckpt.shard":
+                payload = reply["data"]
+            elif method == "ckpt.manifest":
+                payload = reply["manifest"]
+            elif method == "state.get":
+                payload = reply["state"]
+            if payload is not None:
+                self._wire["bytes"] += len(payload)
+                if self._link is not None:
+                    await self._link.transmit(
+                        self._port_to_provider[endpoint[1]], len(payload)
+                    )
+            return reply
+
+    async def start_providers(n):
+        servers = []
+
+        async def get_manifest(peer, args):
+            return {"manifest": manifest.to_bytes()}
+
+        async def get_shard(peer, args):
+            index = int(args["index"])
+            raw = shard_bytes(flat, manifest, index)
+            return {
+                "index": index,
+                "data": serialize_array(
+                    np.frombuffer(raw, dtype=np.float32), CompressionType.NONE
+                ),
+            }
+
+        async def get_state(peer, args):
+            return {"state": blob, "checksum": blob_digest}
+
+        for _ in range(n):
+            server = RPCServer("127.0.0.1", 0)
+            server.register("ckpt.manifest", get_manifest)
+            server.register("ckpt.shard", get_shard)
+            server.register("state.get", get_state)
+            await server.start()
+            servers.append(server)
+        return servers
+
+    async def bench():
+        servers = await start_providers(n_providers)
+        port_to_provider = {s.port: i for i, s in enumerate(servers)}
+        endpoints = [("127.0.0.1", s.port) for s in servers]
+        try:
+            # monolithic: the whole blob from provider 0's uplink
+            mono_wire = {"bytes": 0}
+            client = MeteredClient(
+                port_to_provider, mono_wire,
+                LinkSim(n_providers) if timing else None,
+            )
+            t0 = time.perf_counter()
+            reply = await client.call(endpoints[0], "state.get", {})
+            assert hashlib.sha256(reply["state"]).digest() == blob_digest
+            mono_wall = time.perf_counter() - t0
+            await client.close()
+
+            # sharded: distinct shards from every provider in parallel
+            shard_wire = {"bytes": 0}
+            client = MeteredClient(
+                port_to_provider, shard_wire,
+                LinkSim(n_providers) if timing else None,
+            )
+            anns = [
+                CheckpointAnnouncement(
+                    step=manifest.step, manifest_digest=manifest.digest(),
+                    num_shards=manifest.num_shards, endpoint=list(ep),
+                )
+                for ep in endpoints
+            ]
+            t0 = time.perf_counter()
+            _meta, restored, _m = await sharded_restore(
+                client, anns, parallelism=n_providers * 2, retries=1,
+            )
+            shard_wall = time.perf_counter() - t0
+            np.testing.assert_array_equal(
+                restored["flat/params"], tree["flat/params"]
+            )
+            await client.close()
+            return mono_wall, mono_wire["bytes"], shard_wall, \
+                shard_wire["bytes"]
+        finally:
+            for s in servers:
+                await s.stop()
+
+    mono_wall, mono_bytes, shard_wall, shard_bytes_total = asyncio.run(bench())
+    print(json.dumps({
+        "metric": "checkpoint_restore_sharded_bytes_per_sec",
+        "value": round(manifest.total_bytes / shard_wall, 1),
+        "unit": "bytes/sec",
+        # sharded restore speedup over the single-provider blob on the same
+        # per-provider-uplink link model (0.0 when timing was skipped)
+        "vs_baseline": round(mono_wall / shard_wall, 3) if timing else 0.0,
+        "state_bytes": manifest.total_bytes,
+        "num_shards": manifest.num_shards,
+        "monolithic": {"providers": 1, "wire_bytes": mono_bytes,
+                       "wall_ms": round(mono_wall * 1e3, 2)},
+        "sharded": {"providers": n_providers,
+                    "wire_bytes": shard_bytes_total,
+                    "wall_ms": round(shard_wall * 1e3, 2)},
+    }))
+
+
 def run_swav() -> None:
     """SwAV ResNet-50 step bench (DEDLOC_BENCH=swav): the full jitted
     multicrop train step — trunk fwd/bwd over 2x224 + 6x96 crops, prototypes
@@ -481,6 +661,9 @@ def main() -> None:
         return
     if os.environ.get("DEDLOC_BENCH") == "allreduce_pipeline":
         run_allreduce_pipeline()
+        return
+    if os.environ.get("DEDLOC_BENCH") == "checkpoint_restore":
+        run_checkpoint_restore()
         return
     if os.environ.get("DEDLOC_BENCH") == "swav":
         run_swav()

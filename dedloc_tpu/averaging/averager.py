@@ -29,10 +29,22 @@ from dedloc_tpu.averaging.matchmaking import (
     MatchmakingFailed,
 )
 from dedloc_tpu.averaging.partition import TreeLayout
+from dedloc_tpu.checkpointing import (
+    CheckpointAnnouncement,
+    CheckpointManifest,
+    ShardStore,
+    build_manifest,
+    catalog_key,
+    parse_announcements,
+    publish_announcement,
+    shard_bytes,
+    sharded_restore,
+)
 from dedloc_tpu.core.serialization import (
     CompressionType,
     deserialize_tree,
     pack_obj,
+    serialize_array,
     serialize_tree,
     unpack_obj,
 )
@@ -90,6 +102,24 @@ class DecentralizedAverager:
         # load_state_from_peers)
         state_sync_retries: int = 2,
         state_sync_backoff: float = 0.5,
+        # swarm checkpointing (dedloc_tpu/checkpointing, --checkpoint.*):
+        # fp32 elements per content-addressed shard of the shared state.
+        # <= 0 (the component default) disables sharded serving, catalog
+        # announcements AND the sharded restore path — everything stays on
+        # the full blob. The CollaborativeOptimizer / role configs default
+        # it ON (DEFAULT_SHARD_SIZE); bare averagers opt in explicitly.
+        checkpoint_shard_size: int = 0,
+        # concurrent shard downloads during a sharded restore
+        checkpoint_fetch_parallelism: int = 4,
+        # cap on distinct providers one restore spreads across (0 = all)
+        checkpoint_max_providers: int = 0,
+        # local shard store for RESUMABLE restores (and as a by-product a
+        # durable shard cache); None = in-memory only
+        checkpoint_dir: Optional[str] = None,
+        # the peer's signed metrics subkey (rsa: owner tag): when given,
+        # catalog announcements ride it and are signature-bound to this
+        # peer by the existing record-validator chain
+        signed_subkey: Optional[bytes] = None,
         # per-peer telemetry scope (telemetry/registry.py): in-process
         # multi-peer tests pass one registry per simulated peer; production
         # (one peer per process) leaves None and the process-global
@@ -134,6 +164,22 @@ class DecentralizedAverager:
         self._shared_state_blob: Optional[Tuple[bytes, bytes]] = None
         self._state_lock = threading.Lock()
         self._serialize_task: Optional[asyncio.Task] = None
+        # sharded snapshot cache: (manifest, flat fp32 vector) cut from the
+        # SAME shared-state snapshot — built lazily (first ckpt RPC or
+        # catalog publish), invalidated with the snapshot
+        self.checkpoint_shard_size = int(checkpoint_shard_size)
+        self.checkpoint_fetch_parallelism = int(checkpoint_fetch_parallelism)
+        self.checkpoint_max_providers = int(checkpoint_max_providers)
+        self.signed_subkey = signed_subkey
+        self._ckpt_store = (
+            ShardStore(checkpoint_dir) if checkpoint_dir else None
+        )
+        self._sharded_state: Optional[Tuple[CheckpointManifest, np.ndarray]] = None
+        # (snapshot, message) when the snapshot cannot roundtrip the fp32
+        # flat layout — cached so the full-state flatten is not retried
+        # (and the warning not repeated) on every publish cadence / ckpt RPC
+        self._sharded_state_error: Optional[Tuple[Any, str]] = None
+        self._shard_task: Optional[asyncio.Task] = None
         self.server: Optional[RPCServer] = None
         self.endpoint = None
         self.last_group_size: int = 1
@@ -153,6 +199,12 @@ class DecentralizedAverager:
                         *self._listen, telemetry_registry=self.telemetry
                     )
                     self.server.register("state.get", self._rpc_state_get)
+                    # swarm checkpointing: serve the sharded form of the
+                    # same snapshot (full-blob state.get stays the fallback)
+                    self.server.register(
+                        "ckpt.manifest", self._rpc_ckpt_manifest
+                    )
+                    self.server.register("ckpt.shard", self._rpc_ckpt_shard)
                     await self.server.start()
                     self.endpoint = (self._advertised_host, self.server.port)
                     # every public peer doubles as a circuit relay for
@@ -486,6 +538,8 @@ class DecentralizedAverager:
         with self._state_lock:
             self._shared_state = (tree, metadata)
             self._shared_state_blob = None  # invalidate serialized cache
+            self._sharded_state = None  # and the sharded form
+            self._sharded_state_error = None
 
     async def _rpc_state_get(self, peer, args) -> dict:
         if not self.allow_state_sharing:
@@ -550,6 +604,147 @@ class DecentralizedAverager:
                     )
         return {"state": data, "checksum": digest}
 
+    # ---------------------------------------------------- sharded state serving
+
+    def _sharded_state_sync(
+        self,
+    ) -> Optional[Tuple[CheckpointManifest, np.ndarray]]:
+        """Build (or return the cached) sharded form of the current shared
+        state: manifest + fresh flat fp32 vector. Thread-safe and idempotent
+        — callable from the backup thread (catalog publish) and from the
+        DHT loop's executor (first ckpt RPC); a rare concurrent double
+        build computes the identical result. Returns None when there is no
+        snapshot; raises ValueError when the tree cannot roundtrip through
+        the fp32 layout (callers then stay blob-only)."""
+        if self.checkpoint_shard_size <= 0:
+            return None
+        with self._state_lock:
+            snapshot = self._shared_state
+            cached = self._sharded_state
+            failed = self._sharded_state_error
+        if snapshot is None:
+            return None
+        if cached is not None:
+            return cached
+        if failed is not None and failed[0] is snapshot:
+            # this exact snapshot already failed the roundtrip check —
+            # re-raise without paying the full-state flatten again
+            raise ValueError(failed[1])
+        tree, metadata = snapshot
+        step = int(metadata.get("local_step", metadata.get("step", 0)) or 0)
+        try:
+            built = build_manifest(
+                tree, step, shard_size=self.checkpoint_shard_size,
+                metadata=metadata,
+            )
+        except ValueError as e:
+            # warn ONCE per snapshot (here, at build time); cached retries
+            # and the publish cadence stay silent
+            logger.warning(f"sharded checkpoint serving unavailable: {e}")
+            with self._state_lock:
+                if self._shared_state is snapshot:
+                    self._sharded_state_error = (snapshot, str(e))
+            raise
+        with self._state_lock:
+            if self._shared_state is snapshot:  # not replaced meanwhile
+                self._sharded_state = built
+        return built
+
+    async def _sharded_snapshot(self) -> Tuple[CheckpointManifest, np.ndarray]:
+        """Sharded snapshot for the RPC handlers: built off the event loop
+        (flatten + sha256 over the full state takes seconds at real model
+        sizes) and deduplicated like the blob serialization."""
+        if not self.allow_state_sharing:
+            raise PermissionError("state sharing disabled on this peer")
+        if self.checkpoint_shard_size <= 0:
+            raise FileNotFoundError("sharded checkpoints disabled on this peer")
+        with self._state_lock:
+            cached = self._sharded_state
+        if cached is not None:
+            return cached
+        if self._shard_task is None or self._shard_task.done():
+            loop = asyncio.get_running_loop()
+            self._shard_task = asyncio.ensure_future(
+                loop.run_in_executor(None, self._sharded_state_sync)
+            )
+        built = await asyncio.shield(self._shard_task)
+        if built is None:
+            raise FileNotFoundError("no state snapshot available yet")
+        return built
+
+    async def _rpc_ckpt_manifest(self, peer, args) -> dict:
+        manifest, _flat = await self._sharded_snapshot()
+        return {"manifest": manifest.to_bytes()}
+
+    async def _rpc_ckpt_shard(self, peer, args) -> dict:
+        manifest, flat = await self._sharded_snapshot()
+        index = int(args["index"])
+        raw = shard_bytes(flat, manifest, index)
+        if faults._active is not None:  # fault injection (testing/faults.py)
+            fault = faults.fire("checkpoint.shard_get", index=index,
+                                size=len(raw))
+            if fault is not None and fault.action == "truncate":
+                # the manifest digest stays that of the FULL shard, so the
+                # fetcher's per-shard verification catches the cut; keep the
+                # cut fp32-aligned so frombuffer below still parses and the
+                # failure surfaces as a VERIFY failure, not a server crash
+                cut = int(len(raw) * fault.fraction)
+                raw = raw[: cut - cut % 4]
+                tele_f = telemetry.resolve(self.telemetry)
+                if tele_f is not None:
+                    tele_f.counter("faults.applied").inc()
+                    tele_f.event(
+                        "fault.applied", point="checkpoint.shard_get",
+                        action="truncate", shard=index,
+                    )
+        tele = telemetry.resolve(self.telemetry)
+        if tele is not None:
+            tele.counter("ckpt.shards_served").inc()
+            tele.counter("ckpt.shard_bytes_served").inc(len(raw))
+        return {
+            "index": index,
+            "data": serialize_array(
+                np.frombuffer(raw, dtype=np.float32), CompressionType.NONE
+            ),
+        }
+
+    def publish_checkpoint_announcement(
+        self, expiration: float = 60.0
+    ) -> None:
+        """Announce this peer's sharded checkpoint on the DHT catalog
+        (schema-validated; signature-bound when a signed subkey was given).
+        A full-state provider holds ALL shards, so ``shards`` is None."""
+        if (
+            self.checkpoint_shard_size <= 0
+            or not self.allow_state_sharing
+            or self.endpoint is None
+        ):
+            return
+        try:
+            built = self._sharded_state_sync()
+        except ValueError as e:
+            # tree not representable in the fp32 flat layout: blob-only peer
+            # (_sharded_state_sync warned once at build time)
+            logger.debug(f"sharded checkpoint serving unavailable: {e}")
+            return
+        if built is None:
+            return
+        manifest, _flat = built
+        announcement = CheckpointAnnouncement(
+            step=manifest.step,
+            manifest_digest=manifest.digest(),
+            num_shards=manifest.num_shards,
+            endpoint=list(self.endpoint),
+            shards=None,
+        )
+        publish_announcement(
+            self.dht,
+            self.prefix,
+            self.signed_subkey or self.peer_id,
+            announcement,
+            expiration=expiration,
+        )
+
     def publish_state_provider(
         self, expiration: float = 60.0, step: int = 0
     ) -> None:
@@ -563,6 +758,11 @@ class DecentralizedAverager:
             get_dht_time() + expiration,
             subkey=self.peer_id,
         )
+        # sharded serving rides the same publish cadence: the catalog
+        # record carries the manifest digest, so building the sharded form
+        # here (on the caller's backup thread, off the training path) also
+        # pre-warms what the ckpt RPCs will serve
+        self.publish_checkpoint_announcement(expiration=expiration)
 
     def fetch_state_schema(
         self, timeout: float = 15.0
@@ -643,13 +843,98 @@ class DecentralizedAverager:
         candidates.sort(key=lambda c: -c[0])
         return [ep for _step, ep in candidates]
 
+    def _own_catalog_subkeys(self) -> tuple:
+        return tuple(
+            sk
+            for sk in (getattr(self, "peer_id", None), self.signed_subkey)
+            if sk is not None
+        )
+
+    def _catalog_records(self) -> List[CheckpointAnnouncement]:
+        """Every OTHER peer's checkpoint-catalog announcement, from the
+        caller thread (blocking DHT lookup)."""
+        entry = self.dht.get(catalog_key(self.prefix), latest=True)
+        if entry is None or not hasattr(entry.value, "items"):
+            return []
+        return parse_announcements(
+            ((sk, v.value) for sk, v in entry.value.items()),
+            own_subkeys=self._own_catalog_subkeys(),
+        )
+
+    async def _catalog_records_async(
+        self, node
+    ) -> List[CheckpointAnnouncement]:
+        """Same view, from ON the DHT loop (the restore path runs there)."""
+        entry = await node.get(catalog_key(self.prefix).encode(), latest=True)
+        items = []
+        if entry is not None and hasattr(entry.value, "items"):
+            for sk, v in entry.value.items():
+                try:
+                    items.append((sk, unpack_obj(v.value)))
+                except Exception:  # noqa: BLE001 — undecodable entry
+                    continue
+        return parse_announcements(
+            items, own_subkeys=self._own_catalog_subkeys()
+        )
+
     def best_advertised_state_step(self) -> Optional[int]:
         """Deepest global step any live provider ADVERTISES in its KB-sized
-        DHT record — lets a resumed peer decide whether a download could
-        possibly be newer than its checkpoint without pulling the full
-        multi-hundred-MB state blob. None when nobody shares."""
+        DHT record (full-blob provider records AND checkpoint-catalog
+        announcements) — lets a resumed peer decide whether a download
+        could possibly be newer than its checkpoint without pulling the
+        full multi-hundred-MB state. None when nobody shares."""
         steps = [step for step, _ep in self._advertised_state_records()]
+        if self.checkpoint_shard_size > 0:
+            steps += [a.step for a in self._catalog_records()]
         return max(steps) if steps else None
+
+    async def _try_sharded_restore(
+        self, node, tele, timeout: float, retries: int, backoff: float
+    ) -> Optional[Tuple[Dict[str, Any], Dict[str, np.ndarray]]]:
+        """Multi-peer sharded restore attempt (runs on the DHT loop). Any
+        failure — no catalog, unobtainable manifest, a shard exhausting its
+        ladder — returns None and the caller falls back to the full-blob
+        path; the ``ckpt.restore`` span records the outcome either way."""
+        announcements = await self._catalog_records_async(node)
+        if not announcements:
+            return None
+        with telemetry.span(
+            "ckpt.restore", self.telemetry, mode="sharded"
+        ) as ctx:
+            stats: Dict[str, Any] = {}
+            try:
+                metadata, tree, manifest = await sharded_restore(
+                    self.client,
+                    announcements,
+                    parallelism=self.checkpoint_fetch_parallelism,
+                    retries=retries,
+                    backoff=backoff,
+                    timeout=timeout,
+                    store=self._ckpt_store,
+                    max_providers=self.checkpoint_max_providers,
+                    telemetry_registry=self.telemetry,
+                    stats=stats,
+                )
+            except Exception as e:  # noqa: BLE001 — RestoreFailed et al.
+                ctx["ok"] = False
+                ctx["error"] = type(e).__name__
+                if tele is not None:
+                    tele.counter("ckpt.restore_failures").inc()
+                logger.warning(
+                    f"sharded restore failed ({e!r}); falling back to the "
+                    "full-blob state path"
+                )
+                return None
+            ctx["ok"] = True
+            ctx["step"] = manifest.step
+            ctx["shards"] = manifest.num_shards
+            ctx["bytes"] = manifest.total_bytes
+            # providers ACTUALLY pulled from (selected step/digest, capped),
+            # not the raw announcement count with stale/outvoted peers in it
+            ctx["providers"] = stats.get("providers", 0)
+            if tele is not None:
+                tele.counter("ckpt.restores").inc()
+            return metadata, tree
 
     def load_state_from_peers(
         self,
@@ -658,6 +943,12 @@ class DecentralizedAverager:
         backoff: Optional[float] = None,
     ) -> Optional[Tuple[Dict[str, Any], Dict[str, np.ndarray]]]:
         """Download (metadata, tree) from a live state provider.
+
+        Restore preference order (docs/fleet.md restart runbook): the
+        SHARDED path first — when the checkpoint catalog announces a
+        manifest, distinct shards are pulled from distinct providers in
+        parallel with per-shard sha256 verification (checkpointing/fetcher)
+        — then the single-provider full-blob ladder below as fallback.
 
         Peer-lifecycle robustness contract (``state_sync_retries`` /
         ``state_sync_backoff``): the download is retried with exponential
@@ -676,6 +967,12 @@ class DecentralizedAverager:
         def _fetch(node):
             async def fetch():
                 tele = telemetry.resolve(self.telemetry)
+                if self.checkpoint_shard_size > 0:
+                    result = await self._try_sharded_restore(
+                        node, tele, timeout, retries, backoff
+                    )
+                    if result is not None:
+                        return result
                 failed: set = set()
                 for attempt in range(retries + 1):
                     if attempt:

@@ -66,9 +66,20 @@ def make_validators(
     prefix: str, private_key: Optional[RSAPrivateKey] = None
 ) -> Tuple[List[RecordValidatorBase], bytes]:
     """[schema, signature] validator chain + this peer's public-key subkey
-    (metrics_utils.py:21-24)."""
+    (metrics_utils.py:21-24). The checkpoint-catalog schema rides the same
+    chain: a malformed shard announcement is rejected at the storing node,
+    and announcements published under a peer's owner-tag subkey are
+    signature-bound to that peer (dedloc_tpu/checkpointing/catalog.py)."""
+    from dedloc_tpu.checkpointing.catalog import CheckpointAnnouncement
+
     signature = RSASignatureValidator(private_key)
-    schema = SchemaValidator({"metrics": LocalMetrics}, prefix=prefix)
+    schema = SchemaValidator(
+        {
+            "metrics": LocalMetrics,
+            "checkpoint_catalog": CheckpointAnnouncement,
+        },
+        prefix=prefix,
+    )
     return [schema, signature], signature.local_public_key
 
 

@@ -127,6 +127,17 @@ class CollaborativeOptimizer:
         # (contributes weight 0, still receives the group average)
         state_sync_retries: int = 2,  # bounded state-download retry with
         state_sync_backoff: float = 0.5,  # exponential backoff (averager)
+        checkpoint_shard_size: int = 1 << 20,  # swarm checkpointing
+        # (--checkpoint.*, dedloc_tpu/checkpointing): fp32 elements per
+        # content-addressed shard of the shared state; <= 0 disables the
+        # sharded serve/catalog/restore path (full blob only). Defaults ON
+        # here (deployment surface) while the bare averager defaults OFF.
+        checkpoint_fetch_parallelism: int = 4,
+        checkpoint_max_providers: int = 0,
+        checkpoint_dir: Optional[str] = None,  # local shard cache for
+        # resumable restores (None = in-memory only)
+        signed_subkey: Optional[bytes] = None,  # the peer's signed metrics
+        # subkey: catalog announcements ride it so they are signature-bound
         chunk_size: int = DEFAULT_CHUNK_SIZE,  # elements per wire chunk in
         # the pipelined all-reduce; <= 0 restores monolithic spans (the
         # pre-pipeline wire format) — same contract as --averager.chunk_size
@@ -205,6 +216,11 @@ class CollaborativeOptimizer:
             relay=relay,
             state_sync_retries=state_sync_retries,
             state_sync_backoff=state_sync_backoff,
+            checkpoint_shard_size=checkpoint_shard_size,
+            checkpoint_fetch_parallelism=checkpoint_fetch_parallelism,
+            checkpoint_max_providers=checkpoint_max_providers,
+            checkpoint_dir=checkpoint_dir,
+            signed_subkey=signed_subkey,
             telemetry_registry=telemetry_registry,
         )
         self.tracker = ProgressTracker(

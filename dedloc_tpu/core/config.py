@@ -332,6 +332,31 @@ class TrainingArguments:
 
 
 @dataclass
+class CheckpointArguments:
+    """Swarm checkpointing (dedloc_tpu/checkpointing, docs/fleet.md restart
+    runbook): the shared state is also served as a signed manifest + fixed-
+    size content-addressed shards announced on the DHT catalog, and a
+    joiner/restarted swarm restores by pulling distinct shards from
+    distinct providers in parallel (full-blob download stays the
+    fallback)."""
+
+    # fp32 elements per shard of the flattened state (4 bytes each; the
+    # default 1Mi elements = 4 MiB per shard). <= 0 disables the sharded
+    # path entirely — serving, catalog announcements and sharded restore
+    # all degrade to the single-provider full blob.
+    shard_size: int = 1 << 20
+    # concurrent shard downloads during a restore
+    fetch_parallelism: int = 4
+    # cap on distinct providers one restore spreads across (0 = all
+    # announcing providers)
+    providers: int = 0
+    # local shard cache dir ("" = <output_dir>/shard_cache): fetched shards
+    # persist here so a restore killed mid-flight RESUMES instead of
+    # refetching; "none" disables the cache
+    cache_dir: str = ""
+
+
+@dataclass
 class TelemetryArguments:
     """Swarm telemetry (dedloc_tpu/telemetry, docs/observability.md): a
     process-local registry of counters/histograms + span tracing across the
@@ -371,6 +396,7 @@ class CollaborationArguments:
     training: TrainingArguments = field(default_factory=TrainingArguments)
     auth: AuthArguments = field(default_factory=AuthArguments)
     telemetry: TelemetryArguments = field(default_factory=TelemetryArguments)
+    checkpoint: CheckpointArguments = field(default_factory=CheckpointArguments)
     wandb_project: Optional[str] = None
     bandwidth: float = 1000.0
 
@@ -433,3 +459,4 @@ class SwAVCollaborationArguments:
         default_factory=SwAVTrainingArguments
     )
     telemetry: TelemetryArguments = field(default_factory=TelemetryArguments)
+    checkpoint: CheckpointArguments = field(default_factory=CheckpointArguments)

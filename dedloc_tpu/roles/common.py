@@ -148,6 +148,29 @@ def build_dht(args: CollaborationArguments, client_mode: Optional[bool] = None):
     return dht, public_key
 
 
+def checkpoint_kwargs(args, public_key: bytes) -> Dict:
+    """Resolve ``--checkpoint.*`` knobs into CollaborativeOptimizer kwargs
+    (docs/fleet.md restart runbook). THE one resolution point for the shard
+    cache dir: empty = ``<output_dir>/shard_cache`` (restores resume across
+    process restarts), "none" = no cache."""
+    ck = args.checkpoint
+    if ck.cache_dir == "none":
+        cache_dir = None
+    else:
+        cache_dir = ck.cache_dir or os.path.join(
+            args.training.output_dir, "shard_cache"
+        )
+    return dict(
+        checkpoint_shard_size=ck.shard_size,
+        checkpoint_fetch_parallelism=ck.fetch_parallelism,
+        checkpoint_max_providers=ck.providers,
+        checkpoint_dir=cache_dir,
+        # catalog announcements ride the peer's SIGNED metrics subkey, so
+        # the existing validator chain signature-binds them to this peer
+        signed_subkey=public_key,
+    )
+
+
 def configure_role_telemetry(args, public_key: bytes):
     """Install the process-global swarm-telemetry registry for a role
     (docs/observability.md, ``--telemetry.*`` knobs). THE one place the

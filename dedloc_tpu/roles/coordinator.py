@@ -24,6 +24,7 @@ from dedloc_tpu.core.config import CollaborationArguments, parse_config
 from dedloc_tpu.core.timeutils import get_dht_time
 from dedloc_tpu.roles.common import build_dht, force_cpu_if_requested
 from dedloc_tpu.telemetry import build_swarm_health
+from dedloc_tpu.telemetry import registry as telemetry
 from dedloc_tpu.utils.checkpoint import save_checkpoint
 from dedloc_tpu.utils.logging import get_logger
 
@@ -102,6 +103,11 @@ def run_coordinator(
             args.dht.experiment_prefix,
             client_mode=True,
             allow_state_sharing=False,
+            # state pulls prefer the multi-peer sharded path (and fall
+            # back to the single-provider blob) like any joiner
+            checkpoint_shard_size=args.checkpoint.shard_size,
+            checkpoint_fetch_parallelism=args.checkpoint.fetch_parallelism,
+            checkpoint_max_providers=args.checkpoint.providers,
         )
 
     wandb_run = _maybe_wandb(args)
@@ -182,6 +188,37 @@ def _pull_and_save(args, averager, step, upload_fn, uploads) -> None:
         save_total_limit=args.training.save_total_limit,
     )
     logger.info(f"saved collaboration checkpoint {path}")
+    # swarm checkpointing (--checkpoint.*): write the durable manifest +
+    # content-addressed shards next to the legacy blob (shards unchanged
+    # between steps are stored once), and drop the manifest into the
+    # checkpoint dir so the hub upload below publishes it — a mirror
+    # consumer can then verify shard integrity against the signed digest
+    if getattr(args, "checkpoint", None) and args.checkpoint.shard_size > 0:
+        from dedloc_tpu.checkpointing import save_sharded_checkpoint
+
+        try:
+            manifest = save_sharded_checkpoint(
+                os.path.join(args.training.output_dir, "sharded"),
+                tree,
+                step,
+                shard_size=args.checkpoint.shard_size,
+                metadata=metadata,
+                keep=args.training.save_total_limit,
+            )
+            with open(os.path.join(path, "manifest.bin"), "wb") as f:
+                f.write(manifest.to_bytes())
+            telemetry.inc("ckpt.manifests_written")
+            telemetry.event(
+                "ckpt.manifest_written", step=step,
+                shards=manifest.num_shards, bytes=manifest.total_bytes,
+            )
+            logger.info(
+                f"wrote sharded checkpoint manifest at step {step} "
+                f"({manifest.num_shards} shards)"
+            )
+        except ValueError as e:
+            # a tree that cannot roundtrip the fp32 layout stays blob-only
+            logger.warning(f"sharded checkpoint skipped: {e}")
     if upload_fn is not None:
         # background thread (reference behavior, run_first_peer.py:139): a
         # slow push must not block metrics aggregation or checkpointing.

@@ -42,7 +42,11 @@ from dedloc_tpu.models.swav import (
 from dedloc_tpu.optim.lars import lars
 from dedloc_tpu.optim.schedules import linear_warmup_cosine_annealing
 from dedloc_tpu.parallel.train_step import TrainState, zeros_like_grads
-from dedloc_tpu.roles.common import build_dht, force_cpu_if_requested
+from dedloc_tpu.roles.common import (
+    build_dht,
+    checkpoint_kwargs,
+    force_cpu_if_requested,
+)
 from dedloc_tpu.utils.checkpoint import save_checkpoint
 from dedloc_tpu.utils.logging import get_logger
 
@@ -151,6 +155,9 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
         health_gate_loss_ratio=args.optimizer.health_gate_loss_ratio,
         state_sync_retries=args.averager.state_sync_retries,
         state_sync_backoff=args.averager.state_sync_backoff,
+        # swarm checkpointing (--checkpoint.*): same wiring as the ALBERT
+        # trainer — sharded serving/catalog/restore with blob fallback
+        **checkpoint_kwargs(args, _public_key),
         client_mode=args.dht.client_mode,
         relay=args.dht.relay or None,
         listen_port=args.averager.listen_port,

@@ -34,6 +34,7 @@ from dedloc_tpu.roles.common import (
     build_loss_fn,
     build_model,
     build_optimizer,
+    checkpoint_kwargs,
     configure_role_telemetry,
     drop_collator_keys,
     force_cpu_if_requested,
@@ -250,6 +251,9 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         health_gate_loss_ratio=args.optimizer.health_gate_loss_ratio,
         state_sync_retries=args.averager.state_sync_retries,
         state_sync_backoff=args.averager.state_sync_backoff,
+        # swarm checkpointing (--checkpoint.*): sharded state serving +
+        # catalog announcements + multi-peer restore, blob as fallback
+        **checkpoint_kwargs(args, public_key),
         min_refresh_period=args.averager.min_refresh_period,
         max_refresh_period=args.averager.max_refresh_period,
         default_refresh_period=args.averager.default_refresh_period,

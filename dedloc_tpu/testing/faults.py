@@ -22,6 +22,7 @@ Fault points currently wired:
 | ``rpc.client.call``    | ``RPCClient.call`` before the frame     | method, endpoint, client |
 | ``rpc.server.dispatch``| ``RPCServer._dispatch`` before handler  | method, peer, server, port |
 | ``averager.state_get`` | state-snapshot reply (blob mutation)    | size |
+| ``checkpoint.shard_get`` | sharded-checkpoint shard reply (bytes mutation) | index, size |
 | ``fleet.preempt``      | ``LocalFleet`` victim selection         | alive |
 
 Actions: ``drop`` (reset the connection / raise ConnectionResetError —

@@ -18,6 +18,7 @@ import os
 import re
 import shutil
 import tempfile
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -34,6 +35,38 @@ from dedloc_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 _CKPT_RE = re.compile(r"^checkpoint-(\d+)$")
+
+# a .ckpt-tmp-* dir older than this is an orphan from a crashed save (a
+# LIVE save finishes in seconds-to-minutes); swept on the next save so
+# crashed saves stop accumulating junk in output_dir forever
+ORPHAN_TMP_MAX_AGE_S = 3600.0
+
+
+def sweep_orphan_tmpdirs(
+    output_dir: str,
+    max_age_s: float = ORPHAN_TMP_MAX_AGE_S,
+    now: Optional[float] = None,
+) -> List[str]:
+    """Delete ``.ckpt-tmp-*`` dirs older than ``max_age_s`` (crashed-save
+    leftovers). The age guard keeps a CONCURRENT in-flight save's tmp dir
+    safe. Returns the swept paths."""
+    if not os.path.isdir(output_dir):
+        return []
+    now = time.time() if now is None else now
+    swept = []
+    for name in os.listdir(output_dir):
+        if not name.startswith(".ckpt-tmp-"):
+            continue
+        path = os.path.join(output_dir, name)
+        try:
+            age = now - os.path.getmtime(path)
+        except OSError:
+            continue  # raced with the rename of a completing save
+        if age >= max_age_s:
+            logger.info(f"sweeping orphaned checkpoint tmp dir {path}")
+            shutil.rmtree(path, ignore_errors=True)
+            swept.append(path)
+    return swept
 
 
 def list_checkpoints(output_dir: str) -> List[Tuple[int, str]]:
@@ -64,6 +97,7 @@ def save_checkpoint(
 ) -> str:
     """Atomically write ``checkpoint-<step>`` and rotate old ones."""
     os.makedirs(output_dir, exist_ok=True)
+    sweep_orphan_tmpdirs(output_dir)
     final = os.path.join(output_dir, f"checkpoint-{step}")
     tmp = tempfile.mkdtemp(prefix=".ckpt-tmp-", dir=output_dir)
     try:
@@ -100,10 +134,18 @@ def load_checkpoint(
 def load_latest_checkpoint(
     output_dir: str,
 ) -> Optional[Tuple[int, Dict[str, np.ndarray], Dict[str, Any]]]:
-    """(step, tree, metadata) of the newest checkpoint, or None."""
-    latest = latest_checkpoint(output_dir)
-    if latest is None:
-        return None
-    step, path = latest
-    tree, metadata = load_checkpoint(path)
-    return step, tree, metadata
+    """(step, tree, metadata) of the newest LOADABLE checkpoint, or None.
+
+    A corrupt or truncated ``state.bin`` (host died mid-write on a non-
+    atomic filesystem, disk bit-rot) falls back to the next-newest
+    checkpoint instead of crashing resume — losing ``save_steps`` worth of
+    progress beats losing the run."""
+    for step, path in reversed(list_checkpoints(output_dir)):
+        try:
+            tree, metadata = load_checkpoint(path)
+            return step, tree, metadata
+        except Exception as e:  # noqa: BLE001 — corrupt checkpoint
+            logger.warning(
+                f"checkpoint {path} is corrupt ({e!r}); trying next-newest"
+            )
+    return None

@@ -96,6 +96,52 @@ def test_bench_allreduce_pipeline_contract():
     assert wire["none"] / wire["uint8"] >= 3.5, wire
 
 
+def _run_restore_bench(timing=True):
+    env = dict(os.environ, DEDLOC_BENCH="checkpoint_restore",
+               DEDLOC_BENCH_TINY="1", JAX_PLATFORMS="cpu",
+               DEDLOC_BENCH_TIMING="1" if timing else "0")
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py")],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    json_lines = [
+        l for l in out.stdout.strip().splitlines() if l.startswith("{")
+    ]
+    assert len(json_lines) == 1, out.stdout
+    return json.loads(json_lines[0])
+
+
+@pytest.mark.checkpointing
+def test_bench_checkpoint_restore_contract():
+    """Restore bench, deterministic half (DEDLOC_BENCH_TIMING=0 skips the
+    simulated-uplink sleeps): the JSON must record bytes AND provider
+    counts for both bootstrap paths, and the sharded path's wire bytes may
+    exceed the blob's only by per-shard framing (< 1%)."""
+    record = _run_restore_bench(timing=False)
+    assert record["metric"] == "checkpoint_restore_sharded_bytes_per_sec"
+    assert record["value"] > 0
+    assert record["vs_baseline"] == 0.0  # timing half skipped
+    assert record["monolithic"]["providers"] == 1
+    assert record["sharded"]["providers"] > 1
+    state = record["state_bytes"]
+    assert state <= record["monolithic"]["wire_bytes"] < state * 1.01
+    assert state <= record["sharded"]["wire_bytes"] < state * 1.01
+    assert record["num_shards"] >= record["sharded"]["providers"]
+
+
+@pytest.mark.slow
+@pytest.mark.checkpointing
+def test_bench_checkpoint_restore_sharded_beats_monolithic():
+    """Restore bench, timing half (real sockets + simulated per-provider
+    uplinks, so slow-marked): pulling distinct shards from N providers must
+    beat the one-uplink blob download."""
+    record = _run_restore_bench(timing=True)
+    assert record["vs_baseline"] > 1.0, record
+    assert record["sharded"]["wall_ms"] < record["monolithic"]["wall_ms"]
+
+
 @pytest.mark.slow
 @pytest.mark.wirepath
 def test_bench_allreduce_pipeline_beats_monolithic():

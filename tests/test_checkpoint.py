@@ -59,6 +59,132 @@ def test_empty_dir_has_no_checkpoints(tmp_path):
     assert latest_checkpoint(str(tmp_path)) is None
 
 
+# ------------------------------------------------- rotation edge cases
+
+
+def test_rotation_disabled_keeps_everything(rng, tmp_path):
+    for step in range(1, 8):
+        save_checkpoint(str(tmp_path), step, _tree(rng),
+                        save_total_limit=None)
+    assert [s for s, _ in list_checkpoints(str(tmp_path))] == list(range(1, 8))
+
+
+def test_resave_same_step_counts_once_for_rotation(rng, tmp_path):
+    """Re-saving an existing step replaces it in place — it must not burn a
+    rotation slot or evict a DIFFERENT step."""
+    for step in (10, 20):
+        save_checkpoint(str(tmp_path), step, _tree(rng), save_total_limit=2)
+    tree2 = _tree(rng, 5.0)
+    save_checkpoint(str(tmp_path), 20, tree2, save_total_limit=2)
+    steps = [s for s, _ in list_checkpoints(str(tmp_path))]
+    assert steps == [10, 20]
+    _, out, _ = load_latest_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(out["w"], tree2["w"])
+
+
+def test_rotation_races_reader_holding_oldest_dir(rng, tmp_path):
+    """POSIX contract: rotation deleting checkpoint-<oldest> while a reader
+    holds its state.bin open neither fails the save nor breaks the reader —
+    the held fd stays readable after the unlink."""
+    import os
+
+    from dedloc_tpu.core.serialization import deserialize_tree
+
+    oldest = _tree(rng)
+    save_checkpoint(str(tmp_path), 1, oldest, save_total_limit=2)
+    save_checkpoint(str(tmp_path), 2, _tree(rng), save_total_limit=2)
+    with open(str(tmp_path / "checkpoint-1" / "state.bin"), "rb") as held:
+        save_checkpoint(str(tmp_path), 3, _tree(rng), save_total_limit=2)
+        steps = [s for s, _ in list_checkpoints(str(tmp_path))]
+        assert steps == [2, 3]  # rotation went through
+        assert not os.path.isdir(str(tmp_path / "checkpoint-1"))
+        out = deserialize_tree(held.read())  # reader unaffected
+    np.testing.assert_array_equal(out["w"], oldest["w"])
+
+
+def test_reader_falls_back_when_dir_vanishes_mid_load(rng, tmp_path,
+                                                      monkeypatch):
+    """The OTHER side of the race: a reader that listed checkpoint-<N> just
+    before rotation deleted it falls back to a surviving checkpoint instead
+    of crashing resume."""
+    import shutil
+
+    from dedloc_tpu.utils import checkpoint as ckpt
+
+    save_checkpoint(str(tmp_path), 1, _tree(rng), save_total_limit=None)
+    newest = _tree(rng, 2.0)
+    save_checkpoint(str(tmp_path), 2, _tree(rng), save_total_limit=None)
+    save_checkpoint(str(tmp_path), 1, _tree(rng), save_total_limit=None)
+
+    real_load = ckpt.load_checkpoint
+
+    def racing_load(path):
+        if path.endswith("checkpoint-2"):
+            shutil.rmtree(path)  # rotation wins the race
+        return real_load(path)
+
+    monkeypatch.setattr(ckpt, "load_checkpoint", racing_load)
+    loaded = load_latest_checkpoint(str(tmp_path))
+    assert loaded is not None and loaded[0] == 1
+
+
+# ------------------------------------- orphan sweep + corrupt fallback
+
+
+def test_orphan_tmpdirs_swept_on_next_save(rng, tmp_path):
+    """Crashed saves leave .ckpt-tmp-* dirs; the next save sweeps stale
+    ones but leaves a FRESH tmp dir (a concurrent in-flight save) alone."""
+    import os
+
+    stale = tmp_path / ".ckpt-tmp-stale"
+    stale.mkdir()
+    (stale / "state.bin").write_bytes(b"partial")
+    old = os.path.getmtime(str(stale)) - 7200
+    os.utime(str(stale), (old, old))
+    fresh = tmp_path / ".ckpt-tmp-inflight"
+    fresh.mkdir()
+
+    save_checkpoint(str(tmp_path), 1, _tree(rng))
+    names = set(os.listdir(str(tmp_path)))
+    assert ".ckpt-tmp-stale" not in names
+    assert ".ckpt-tmp-inflight" in names
+    assert "checkpoint-1" in names
+
+
+def test_sweep_orphan_tmpdirs_direct(tmp_path):
+    from dedloc_tpu.utils.checkpoint import sweep_orphan_tmpdirs
+
+    (tmp_path / ".ckpt-tmp-a").mkdir()
+    swept = sweep_orphan_tmpdirs(str(tmp_path), max_age_s=0.0)
+    assert len(swept) == 1
+    assert sweep_orphan_tmpdirs(str(tmp_path / "nope")) == []
+
+
+def test_corrupt_newest_falls_back_to_next(rng, tmp_path):
+    """A truncated state.bin (died mid-write on a non-atomic fs, bit-rot)
+    must cost save_steps of progress, not the run."""
+    good = _tree(rng)
+    save_checkpoint(str(tmp_path), 10, good, metadata={"step": 10},
+                    save_total_limit=None)
+    save_checkpoint(str(tmp_path), 20, _tree(rng, 2.0),
+                    save_total_limit=None)
+    state = tmp_path / "checkpoint-20" / "state.bin"
+    state.write_bytes(state.read_bytes()[:16])  # truncate
+    loaded = load_latest_checkpoint(str(tmp_path))
+    assert loaded is not None
+    step, out, meta = loaded
+    assert step == 10 and meta["step"] == 10
+    np.testing.assert_array_equal(out["w"], good["w"])
+
+
+def test_all_checkpoints_corrupt_returns_none(rng, tmp_path):
+    save_checkpoint(str(tmp_path), 1, _tree(rng), save_total_limit=None)
+    save_checkpoint(str(tmp_path), 2, _tree(rng), save_total_limit=None)
+    for step in (1, 2):
+        (tmp_path / f"checkpoint-{step}" / "state.bin").write_bytes(b"\x00")
+    assert load_latest_checkpoint(str(tmp_path)) is None
+
+
 # ------------------------------------------------------------- metrics bus
 
 

@@ -74,6 +74,127 @@ def test_build_upload_fn_resolution(tmp_path):
     assert build_upload_fn(hub_git_dir=str(tmp_path / "g")) is not None
 
 
+# ---------------------------------------------- coordinator upload contract
+# (_pull_and_save's seam: one upload in flight at a time, a skipped step is
+# covered by the next interval, a hub blip never kills the coordinator, and
+# the sharded manifest rides the published checkpoint dir)
+
+
+class _FakeAverager:
+    """Stands in for the coordinator's client-mode averager."""
+
+    def __init__(self, tree=None, step=1):
+        self.tree = tree
+        self.step = step
+
+    def load_state_from_peers(self, *a, **k):
+        if self.tree is None:
+            return None
+        return {"step": self.step, "local_step": self.step}, self.tree
+
+
+def _coordinator_args(tmp_path, shard_size=0):
+    from dedloc_tpu.core.config import CollaborationArguments, parse_config
+
+    return parse_config(
+        CollaborationArguments,
+        ["--training.output_dir", str(tmp_path / "out"),
+         "--training.save_total_limit", "3",
+         "--checkpoint.shard_size", str(shard_size)],
+    )
+
+
+def test_pull_and_save_one_upload_in_flight(rng, tmp_path):
+    import threading
+
+    from dedloc_tpu.roles.coordinator import _pull_and_save
+
+    args = _coordinator_args(tmp_path)
+    gate = threading.Event()
+    uploaded = []
+
+    def slow_upload(path, step):
+        uploaded.append((step, path))
+        assert gate.wait(timeout=30), "test never released the upload gate"
+
+    tree = {"w": rng.standard_normal((4,)).astype(np.float32)}
+    uploads = {"thread": None}
+    _pull_and_save(args, _FakeAverager(tree, 1), 1, slow_upload, uploads)
+    first = uploads["thread"]
+    assert first is not None and first.is_alive()
+    # a new checkpoint while the push is in flight: saved, upload SKIPPED
+    _pull_and_save(args, _FakeAverager(tree, 2), 2, slow_upload, uploads)
+    assert uploads["thread"] is first, "second upload must not launch"
+    assert [s for s, _ in uploaded] == [1]
+    assert os.path.isdir(os.path.join(str(tmp_path / "out"), "checkpoint-2"))
+    gate.set()
+    first.join(timeout=10)
+    # the next interval covers the skipped step: latest state goes up
+    _pull_and_save(args, _FakeAverager(tree, 3), 3, slow_upload, uploads)
+    uploads["thread"].join(timeout=10)
+    assert [s for s, _ in uploaded] == [1, 3]
+    assert uploaded[-1][1].endswith("checkpoint-3")
+
+
+def test_pull_and_save_upload_failure_contained(rng, tmp_path):
+    """A hub blip fails ONE push, not the coordinator: the exception stays
+    on the upload thread and the next interval uploads again."""
+    from dedloc_tpu.roles.coordinator import _pull_and_save
+
+    args = _coordinator_args(tmp_path)
+    calls = []
+
+    def flaky_upload(path, step):
+        calls.append(step)
+        if step == 1:
+            raise RuntimeError("remote hung up")
+
+    tree = {"w": np.ones((4,), np.float32)}
+    uploads = {"thread": None}
+    _pull_and_save(args, _FakeAverager(tree, 1), 1, flaky_upload, uploads)
+    uploads["thread"].join(timeout=10)
+    _pull_and_save(args, _FakeAverager(tree, 2), 2, flaky_upload, uploads)
+    uploads["thread"].join(timeout=10)
+    assert calls == [1, 2]
+
+
+def test_pull_and_save_no_providers_skips_everything(tmp_path):
+    from dedloc_tpu.roles.coordinator import _pull_and_save
+
+    args = _coordinator_args(tmp_path)
+    uploads = {"thread": None}
+    _pull_and_save(args, _FakeAverager(None), 5, None, uploads)
+    assert uploads["thread"] is None
+    assert not os.path.isdir(os.path.join(str(tmp_path / "out"),
+                                          "checkpoint-5"))
+
+
+def test_pull_and_save_publishes_sharded_manifest(rng, tmp_path):
+    """With --checkpoint.shard_size set, every pulled state also lands as a
+    durable manifest + content-addressed shards, and the manifest rides the
+    published checkpoint dir so hub consumers can verify shard integrity."""
+    from dedloc_tpu.checkpointing import CheckpointManifest, ShardStore
+    from dedloc_tpu.roles.coordinator import _pull_and_save
+
+    args = _coordinator_args(tmp_path, shard_size=4)
+    uploaded = []
+    tree = {"w": rng.standard_normal((11,)).astype(np.float32)}
+    uploads = {"thread": None}
+    _pull_and_save(args, _FakeAverager(tree, 7), 7,
+                   lambda path, step: uploaded.append(path), uploads)
+    uploads["thread"].join(timeout=10)
+
+    out = str(tmp_path / "out")
+    with open(os.path.join(out, "checkpoint-7", "manifest.bin"), "rb") as f:
+        manifest = CheckpointManifest.from_bytes(f.read())
+    assert manifest.step == 7 and manifest.num_shards == 3  # ceil(11/4)
+    store = ShardStore(os.path.join(out, "sharded"))
+    assert store.manifest_steps() == [7]
+    assert store.missing_shards(manifest) == []
+    # the uploaded checkpoint dir carries the manifest next to state.bin
+    assert os.path.isfile(os.path.join(uploaded[0], "manifest.bin"))
+
+
 def test_coordinator_publishes_to_hub(tmp_path):
     """End-to-end: a sharing trainer peer + coordinator loop with
     upload_interval -> checkpoint lands in the hub mirror."""

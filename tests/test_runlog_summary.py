@@ -109,6 +109,37 @@ def test_health_view_renders_rounds_faults_and_per_peer_table(
     assert row_b == "| peerB | 1 | 1 | 0 | 0 | 0 | 0 | 0 |"
 
 
+def test_health_view_renders_checkpoint_restore_section(tmp_path, capsys):
+    """The checkpoint/restore table renders manifest writes, restore spans
+    and per-peer shard failure counts next to the wire-path view (ISSUE 5
+    satellite; emitters: roles/coordinator.py, checkpointing/fetcher.py,
+    averaging/averager.py)."""
+    events = [
+        {"t": 50.0, "peer": "coord", "event": "ckpt.manifest_written",
+         "step": 100, "shards": 8, "bytes": 1048576},
+        {"t": 60.0, "peer": "joiner", "event": "ckpt.shard_fetch_failed",
+         "shard": 3, "provider": ["127.0.0.1", 1], "attempt": 1,
+         "error": "ConnectionResetError"},
+        {"t": 60.1, "peer": "joiner", "event": "ckpt.shard_verify_failure",
+         "shard": 5, "provider": ["127.0.0.1", 2], "attempt": 1},
+        {"t": 61.0, "peer": "joiner", "event": "ckpt.restore",
+         "dur_s": 1.25, "mode": "sharded", "ok": True, "step": 100,
+         "shards": 8, "bytes": 1048576, "providers": 3},
+    ]
+    runlog_summary.main(["--health", _write_events(tmp_path, events)])
+    out = capsys.readouterr().out
+    assert "checkpoint / restore:" in out
+    assert "manifest written step=100 shards=8" in out
+    (restore_row,) = [ln for ln in out.splitlines()
+                      if ln.startswith("| joiner | sharded |")]
+    assert restore_row == (
+        "| joiner | sharded | ok | 1.250s | 8 | 1048576 | 3 |"
+    )
+    (fail_row,) = [ln for ln in out.splitlines()
+                   if ln.startswith("| joiner | 1 |")]
+    assert fail_row == "| joiner | 1 | 1 |"
+
+
 def test_health_view_merges_logs_and_skips_old_schema_rows(tmp_path, capsys):
     """Several peers' event logs merge into one timeline (sorted by t), and
     an old-schema train_log row mixed into a file is skipped, not fatal."""

@@ -1,0 +1,48 @@
+"""tools/t1_budget.py: the tier-1 timing-budget attribution tool must parse
+pytest --durations blocks and rank offenders against the 870s cap
+(memory/tier1-timing-budget.md: the suite already overruns it — this tool is
+how new slow tests get caught before they push passing tests past the kill
+line)."""
+import importlib.util
+from pathlib import Path
+
+spec = importlib.util.spec_from_file_location(
+    "t1_budget",
+    Path(__file__).resolve().parent.parent / "tools" / "t1_budget.py",
+)
+t1_budget = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(t1_budget)
+
+_LOG = """\
+============================= slowest durations ==============================
+120.50s call     tests/test_scale.py::test_32_peers
+12.00s call     tests/test_faults.py::test_leader_death
+0.30s setup    tests/test_faults.py::test_leader_death
+3.00s call     tests/test_core.py::test_quick
+not a duration row
+========================== 300 passed in 140.00s ==============================
+"""
+
+
+def test_parse_and_aggregate():
+    rows = t1_budget.parse_durations(_LOG.splitlines())
+    assert len(rows) == 4  # setup/teardown rows count too
+    per_test, per_file = t1_budget.aggregate(rows)
+    assert per_test["tests/test_faults.py::test_leader_death"] == 12.3
+    assert per_file["tests/test_faults.py"] == 12.3
+    assert per_file["tests/test_scale.py"] == 120.5
+
+
+def test_report_ranks_and_flags_slow_candidates():
+    rows = t1_budget.parse_durations(_LOG.splitlines())
+    report = t1_budget.report(rows, cap=100.0, top=2, slow_threshold=10.0)
+    assert "OVER BUDGET" in report  # 135.8s accounted vs cap 100
+    lines = report.splitlines()
+    table = [l for l in lines if l.startswith("| tests/")]
+    assert "test_32_peers" in table[0]  # ranked worst-first
+    assert "slow-mark candidates" in report
+    assert "test_quick" not in report.split("slow-mark candidates")[1]
+
+
+def test_report_without_durations_explains():
+    assert "--durations=0" in t1_budget.report([])

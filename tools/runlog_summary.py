@@ -196,6 +196,50 @@ def print_health(rows):
                 f" {a['chunks'] / k:.1f} |"
             )
 
+    # checkpoint/restore view (swarm checkpointing, docs/fleet.md restart
+    # runbook): manifest writes from the coordinator, each peer's restore
+    # span (sharded vs blob, wall, shards, providers), and the per-peer
+    # shard fetch/verify failure counts the retry ladder absorbed
+    manifests = [r for r in rows if r["event"] == "ckpt.manifest_written"]
+    restores = [r for r in rows if r["event"] == "ckpt.restore"]
+    ckpt_failures = {}
+    for r in rows:
+        if r["event"] in ("ckpt.shard_fetch_failed",
+                          "ckpt.shard_verify_failure"):
+            acc = ckpt_failures.setdefault(r.get("peer", "?"),
+                                           {"fetch": 0, "verify": 0})
+            if r["event"] == "ckpt.shard_fetch_failed":
+                acc["fetch"] += 1
+            else:
+                acc["verify"] += 1
+    if manifests or restores or ckpt_failures:
+        print("\ncheckpoint / restore:")
+        for r in manifests:
+            print(
+                f"  +{r.get('t', 0.0) - t0:8.2f}s  "
+                f"peer={r.get('peer', '?'):<12} manifest written "
+                f"step={r.get('step', '?')} shards={r.get('shards', '?')} "
+                f"bytes={r.get('bytes', '?')}"
+            )
+        if restores:
+            print("| peer | mode | ok | restore wall | shards | bytes |"
+                  " providers |")
+            print("|---|---|---|---|---|---|---|")
+            for r in restores:
+                ok = r.get("ok")
+                print(
+                    f"| {r.get('peer', '?')} | {r.get('mode', '?')} |"
+                    f" {'ok' if ok else 'FAILED'} |"
+                    f" {r.get('dur_s', 0.0):.3f}s | {r.get('shards', '-')} |"
+                    f" {r.get('bytes', '-')} | {r.get('providers', '-')} |"
+                )
+        if ckpt_failures:
+            print("| peer | shard fetch failures | shard verify failures |")
+            print("|---|---|---|")
+            for peer in sorted(ckpt_failures):
+                f = ckpt_failures[peer]
+                print(f"| {peer} | {f['fetch']} | {f['verify']} |")
+
     print("\n| peer | events | faults | sync retries | checksum fails |"
           " rpc failures | join failures | grads dropped |")
     print("|---|---|---|---|---|---|---|---|")

@@ -1,0 +1,120 @@
+"""Tier-1 timing budget: rank the suite's slowest tests against the cap.
+
+The ROADMAP tier-1 command runs under ``timeout 870``; on this container the
+suite already overruns that cap (memory/tier1-timing-budget.md), so every
+new slow test silently pushes passing tests past the kill line. This tool
+turns a ``pytest --durations=0`` log into an attribution: which tests (and
+which files) spend the budget, and which are candidates for a ``slow`` mark.
+
+Usage::
+
+    # run tier-1 with durations reporting, then attribute:
+    pytest tests/ -q -m 'not slow' --durations=0 2>&1 | tee /tmp/_t1.log
+    python tools/t1_budget.py /tmp/_t1.log
+    python tools/t1_budget.py --cap 870 --top 25 --slow-threshold 10 /tmp/_t1.log
+
+Reads stdin when no file is given. Only stdlib, no pytest plugin — it
+parses the human-readable durations block, so it also works on archived CI
+logs.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+# "12.34s call     tests/test_roles.py::test_x" (also setup/teardown rows)
+_DURATION_RE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)\s*$"
+)
+
+
+def parse_durations(lines) -> List[Tuple[str, str, float]]:
+    """(test id, phase, seconds) rows from a pytest --durations block."""
+    rows = []
+    for line in lines:
+        m = _DURATION_RE.match(line)
+        if m:
+            rows.append((m.group(3), m.group(2), float(m.group(1))))
+    return rows
+
+
+def aggregate(rows) -> Tuple[Dict[str, float], Dict[str, float]]:
+    """Sum phases per test and per file."""
+    per_test: Dict[str, float] = defaultdict(float)
+    per_file: Dict[str, float] = defaultdict(float)
+    for test_id, _phase, seconds in rows:
+        per_test[test_id] += seconds
+        per_file[test_id.split("::", 1)[0]] += seconds
+    return dict(per_test), dict(per_file)
+
+
+def report(
+    rows, cap: float = 870.0, top: int = 20, slow_threshold: float = 10.0
+) -> str:
+    if not rows:
+        return (
+            "no duration rows found — run pytest with --durations=0 "
+            "(--durations=N hides everything under its cutoff)"
+        )
+    per_test, per_file = aggregate(rows)
+    total = sum(seconds for _t, _p, seconds in rows)
+    out = []
+    out.append(f"accounted test time: {total:.0f}s vs tier-1 cap {cap:.0f}s "
+               f"({total / cap * 100:.0f}% of budget)")
+    if total > cap:
+        out.append(
+            f"OVER BUDGET by {total - cap:.0f}s — the cap kills the run "
+            "before the suite finishes; slow-mark or split the offenders"
+        )
+    out.append("")
+    out.append(f"top {top} tests:")
+    out.append("| test | total s | % of cap |")
+    out.append("|---|---|---|")
+    ranked = sorted(per_test.items(), key=lambda kv: -kv[1])[:top]
+    for test_id, seconds in ranked:
+        out.append(f"| {test_id} | {seconds:.1f} | {seconds / cap * 100:.1f}% |")
+    out.append("")
+    out.append("per-file totals:")
+    out.append("| file | total s |")
+    out.append("|---|---|")
+    for path, seconds in sorted(per_file.items(), key=lambda kv: -kv[1]):
+        out.append(f"| {path} | {seconds:.1f} |")
+    candidates = [
+        test_id for test_id, seconds in per_test.items()
+        if seconds >= slow_threshold
+    ]
+    if candidates:
+        out.append("")
+        out.append(
+            f"slow-mark candidates (>= {slow_threshold:.0f}s; verify each is "
+            "an integration scenario with a cheap tier-1 sibling first):"
+        )
+        for test_id in sorted(candidates, key=lambda t: -per_test[t]):
+            out.append(f"  {test_id}  ({per_test[test_id]:.1f}s)")
+    return "\n".join(out)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("log", nargs="?", help="pytest log (default: stdin)")
+    parser.add_argument("--cap", type=float, default=870.0,
+                        help="tier-1 wall cap in seconds (ROADMAP: 870)")
+    parser.add_argument("--top", type=int, default=20)
+    parser.add_argument("--slow-threshold", type=float, default=10.0,
+                        help="per-test seconds above which to suggest a "
+                             "slow mark")
+    args = parser.parse_args(argv)
+    if args.log:
+        with open(args.log, encoding="utf-8", errors="replace") as f:
+            rows = parse_durations(f)
+    else:
+        rows = parse_durations(sys.stdin)
+    print(report(rows, cap=args.cap, top=args.top,
+                 slow_threshold=args.slow_threshold))
+
+
+if __name__ == "__main__":
+    main()
