@@ -65,6 +65,7 @@ from dedloc_tpu.core.serialization import (
 from dedloc_tpu.averaging.partition import partition_weighted
 from dedloc_tpu.dht.protocol import Endpoint, RPCClient, RPCError, RPCServer
 from dedloc_tpu.telemetry import registry as telemetry
+from dedloc_tpu.telemetry.links import endpoint_key
 from dedloc_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -348,7 +349,13 @@ class GroupAllReduce:
 
         tele = telemetry.resolve(self.telemetry)
         span_cm = (
-            tele.span("allreduce.round", round_id=round_id, group_size=n)
+            # trace_seed: every member derives the round's trace id from the
+            # shared round_id, so per-peer traces stitch even without an
+            # enclosing avg.round span (bare GroupAllReduce harnesses)
+            tele.span(
+                "allreduce.round", trace_seed=round_id, round_id=round_id,
+                group_size=n,
+            )
             if tele is not None
             else telemetry.null_span()
         )
@@ -395,6 +402,21 @@ class GroupAllReduce:
     ) -> np.ndarray:
         n = len(endpoints)
         tele = telemetry.resolve(self.telemetry)
+        # per-destination wire accounting for THIS round: folded into the
+        # link estimator (telemetry/links.py) per chunk, and emitted as one
+        # allreduce.link event per remote host at round end — the per-hop
+        # rows the --trace timeline and the --topology matrix are built from
+        link_acc: Dict[int, Dict[str, float]] = {}
+
+        def _acc(j: int) -> Dict[str, float]:
+            if j not in link_acc:
+                link_acc[j] = {
+                    "sent_bytes": 0.0, "recv_bytes": 0.0, "chunks_sent": 0.0,
+                    "chunks_recv": 0.0, "send_s": 0.0, "wait_s": 0.0,
+                    "max_chunk_s": 0.0,
+                }
+            return link_acc[j]
+
         out = np.empty(len(vector), np.float32)
         # one chunk-bounds derivation per host, shared by the gather loop,
         # the scatter build and the telemetry count below — these MUST agree
@@ -426,14 +448,25 @@ class GroupAllReduce:
             np.copyto(out[clo:chi], data.reshape(-1), casting="unsafe")
             if tele is not None:
                 raw = (chi - clo) * 4
+                dt = time.perf_counter() - t0
+                wire = len(reply["data"])
                 tele.counter("allreduce.bytes_received").inc(raw)
                 tele.counter("allreduce.chunks_received").inc()
-                tele.counter("avg.bytes_saved").inc(
-                    max(0, raw - len(reply["data"]))
-                )
-                tele.histogram("allreduce.chunk_latency_s").observe(
-                    time.perf_counter() - t0
-                )
+                tele.counter("avg.bytes_saved").inc(max(0, raw - wire))
+                tele.histogram("allreduce.chunk_latency_s").observe(dt)
+                # NOT fed into the LinkTable: this wall includes the host's
+                # reduce/straggler park (the request waits for the chunk to
+                # finalize), which would blame a stalled SENDER's delay on
+                # the innocent host's link — the persistent per-link
+                # estimator only eats pure wire timings (the scatter path);
+                # the round-scoped wait still lands on the allreduce.link
+                # event below, where --trace reads it WITH the straggler
+                # events that explain it
+                acc = _acc(j)
+                acc["recv_bytes"] += wire
+                acc["chunks_recv"] += 1
+                acc["wait_s"] += dt
+                acc["max_chunk_s"] = max(acc["max_chunk_s"], dt)
 
         async def fetch_own(c: int, clo: int, chi: int) -> None:
             data = await asyncio.shield(my_state.chunk(c).done)
@@ -541,6 +574,7 @@ class GroupAllReduce:
                     tele.counter("avg.bytes_saved").inc(
                         max(0, raw - len(payload))
                     )
+                t0 = time.perf_counter()
                 await self.client.call(
                     endpoints[j], "avg.part",
                     {
@@ -549,6 +583,16 @@ class GroupAllReduce:
                     },
                     timeout=self.timeout,
                 )
+                if tele is not None:
+                    dt = time.perf_counter() - t0
+                    tele.links().observe_transfer(
+                        endpoints[j], len(payload), dt
+                    )
+                    acc = _acc(j)
+                    acc["sent_bytes"] += len(payload)
+                    acc["chunks_sent"] += 1
+                    acc["send_s"] += dt
+                    acc["max_chunk_s"] = max(acc["max_chunk_s"], dt)
 
             for row in range(max((len(h) for h in per_host), default=0)):
                 for host_chunks in per_host:
@@ -596,4 +640,22 @@ class GroupAllReduce:
                 time.perf_counter() - gather_start, 6
             )
             ctx["chunks"] = sum(len(c) for c in chunks_by_host)
+        if tele is not None:
+            # one allreduce.link event per remote hop of this round: which
+            # link each byte crossed, how long this member waited on it —
+            # the rows --trace attributes a stall with, and (with link.stats)
+            # the per-link input --topology ranks links by
+            for j in sorted(link_acc):
+                acc = link_acc[j]
+                tele.event(
+                    "allreduce.link", round_id=round_id,
+                    dst=endpoint_key(endpoints[j]),
+                    sent_bytes=int(acc["sent_bytes"]),
+                    recv_bytes=int(acc["recv_bytes"]),
+                    chunks_sent=int(acc["chunks_sent"]),
+                    chunks_recv=int(acc["chunks_recv"]),
+                    send_s=round(acc["send_s"], 6),
+                    wait_s=round(acc["wait_s"], 6),
+                    max_chunk_s=round(acc["max_chunk_s"], 6),
+                )
         return out

@@ -207,6 +207,17 @@ class DecentralizedAverager:
                     self.server.register("ckpt.shard", self._rpc_ckpt_shard)
                     await self.server.start()
                     self.endpoint = (self._advertised_host, self.server.port)
+                    tele_setup = telemetry.resolve(self.telemetry)
+                    if tele_setup is not None:
+                        # self-identification for the topology views: maps
+                        # this peer's label to the endpoint other peers'
+                        # link estimates name as their dst
+                        from dedloc_tpu.telemetry.links import endpoint_key
+
+                        tele_setup.event(
+                            "peer.endpoint",
+                            endpoint=endpoint_key(self.endpoint),
+                        )
                     # every public peer doubles as a circuit relay for
                     # private peers (p2p/circuit-relay.md relay_enabled)
                     self.relay_service = RelayService(self.server)
@@ -471,8 +482,14 @@ class DecentralizedAverager:
                 tree, weight, round_id, expected_size, window
             )
         # one span per averaging round: matchmaking + allreduce + weight,
-        # the unit the operator asks "why was step N slow" about
-        with tele.span("avg.round", round_id=round_id, weight=weight) as ctx:
+        # the unit the operator asks "why was step N slow" about. The trace
+        # id derives from the swarm-unique round_id, so every member's spans
+        # (and, via the RPC framing's trace context, every serve span they
+        # cause on other peers) stitch into ONE cross-peer trace
+        with tele.span(
+            "avg.round", trace_seed=round_id, round_id=round_id,
+            weight=weight,
+        ) as ctx:
             averaged, group_size = await self._step_inner(
                 tree, weight, round_id, expected_size, window
             )
@@ -541,7 +558,34 @@ class DecentralizedAverager:
             self._sharded_state = None  # and the sharded form
             self._sharded_state_error = None
 
+    def _serve_span(self, name: str, **attrs):
+        """Server-side serve span for a state/checkpoint RPC handler: under
+        the trace context the dispatch adopted off the request frame, its
+        remote parent is the calling peer's span (state_sync attempt,
+        ckpt.restore), so --trace shows the provider-side half of every
+        download hop. Null span when telemetry is off."""
+        tele = telemetry.resolve(self.telemetry)
+        return (
+            tele.span(name, **attrs) if tele is not None
+            else telemetry.null_span()
+        )
+
     async def _rpc_state_get(self, peer, args) -> dict:
+        with self._serve_span(
+            "state.serve", schema_only=bool(args.get("schema_only"))
+        ) as ctx:
+            try:
+                reply = await self._rpc_state_get_inner(peer, args)
+            except Exception as e:
+                ctx["ok"] = False
+                ctx["error"] = type(e).__name__
+                raise
+            ctx["ok"] = True
+            if "state" in reply:
+                ctx["bytes"] = len(reply["state"])
+            return reply
+
+    async def _rpc_state_get_inner(self, peer, args) -> dict:
         if not self.allow_state_sharing:
             raise PermissionError("state sharing disabled on this peer")
         with self._state_lock:
@@ -673,10 +717,32 @@ class DecentralizedAverager:
         return built
 
     async def _rpc_ckpt_manifest(self, peer, args) -> dict:
-        manifest, _flat = await self._sharded_snapshot()
-        return {"manifest": manifest.to_bytes()}
+        with self._serve_span("ckpt.manifest.serve") as ctx:
+            try:
+                manifest, _flat = await self._sharded_snapshot()
+            except Exception as e:
+                ctx["ok"] = False
+                ctx["error"] = type(e).__name__
+                raise
+            ctx["ok"] = True
+            ctx["step"] = manifest.step
+            return {"manifest": manifest.to_bytes()}
 
     async def _rpc_ckpt_shard(self, peer, args) -> dict:
+        with self._serve_span(
+            "ckpt.shard.serve", shard=int(args.get("index", -1))
+        ) as ctx:
+            try:
+                reply = await self._rpc_ckpt_shard_inner(peer, args)
+            except Exception as e:
+                ctx["ok"] = False
+                ctx["error"] = type(e).__name__
+                raise
+            ctx["ok"] = True
+            ctx["bytes"] = len(reply["data"])
+            return reply
+
+    async def _rpc_ckpt_shard_inner(self, peer, args) -> dict:
         manifest, flat = await self._sharded_snapshot()
         index = int(args["index"])
         raw = shard_bytes(flat, manifest, index)
@@ -932,6 +998,10 @@ class DecentralizedAverager:
             # providers ACTUALLY pulled from (selected step/digest, capped),
             # not the raw announcement count with stale/outvoted peers in it
             ctx["providers"] = stats.get("providers", 0)
+            if stats.get("provider_bytes"):
+                # verified bytes per provider endpoint: which uplinks this
+                # restore actually rode (fast-provider preference input)
+                ctx["provider_bytes"] = stats["provider_bytes"]
             if tele is not None:
                 tele.counter("ckpt.restores").inc()
             return metadata, tree

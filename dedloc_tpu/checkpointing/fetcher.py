@@ -15,6 +15,7 @@ DHT loop with its pooled RPCClient).
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -31,6 +32,7 @@ from dedloc_tpu.checkpointing.manifest import (
 from dedloc_tpu.checkpointing.store import ShardStore
 from dedloc_tpu.core.serialization import deserialize_array
 from dedloc_tpu.telemetry import registry as telemetry
+from dedloc_tpu.telemetry.links import endpoint_key
 from dedloc_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -97,6 +99,7 @@ async def _fetch_one_shard(
     store: Optional[ShardStore],
     failed_providers: set,
     tele,
+    provider_bytes: Optional[Dict[str, int]] = None,
 ) -> np.ndarray:
     candidates = _candidates_for(index, providers)
     if not candidates:
@@ -115,9 +118,11 @@ async def _fetch_one_shard(
         pool = pool or candidates
         ep = pool[attempt % len(pool)]
         try:
+            t0 = time.perf_counter()
             reply = await client.call(
                 ep, "ckpt.shard", {"index": index}, timeout=timeout
             )
+            fetch_s = time.perf_counter() - t0
             raw = np.ascontiguousarray(
                 deserialize_array(reply["data"]), dtype=np.float32
             ).tobytes()
@@ -141,6 +146,18 @@ async def _fetch_one_shard(
             if tele is not None:
                 tele.counter("ckpt.shards_fetched").inc()
                 tele.counter("ckpt.shard_bytes_fetched").inc(len(raw))
+                # per-provider goodput: what restore provider selection will
+                # later prefer fast providers by — and the same observation
+                # feeds the per-link estimator (telemetry/links.py), so a
+                # provider that is ALSO an averaging partner shares one
+                # link record across both subsystems
+                tele.histogram("ckpt.provider_goodput").observe(
+                    len(raw) / max(fetch_s, 1e-6)
+                )
+                tele.links().observe_transfer(ep, len(raw), fetch_s)
+            if provider_bytes is not None:
+                key = endpoint_key(ep)
+                provider_bytes[key] = provider_bytes.get(key, 0) + len(raw)
             return vec
         except Exception as e:  # noqa: BLE001 — retry ladder
             failed_providers.add(ep)
@@ -176,10 +193,12 @@ async def fetch_shards(
     timeout: float = 30.0,
     store: Optional[ShardStore] = None,
     telemetry_registry=None,
+    provider_bytes: Optional[Dict[str, int]] = None,
 ) -> Dict[int, np.ndarray]:
     """Fetch (and verify) every shard of ``manifest``, resuming from
     ``store`` when given. Raises RestoreFailed if any shard cannot be
-    obtained."""
+    obtained. ``provider_bytes`` (when given) accumulates verified bytes
+    per provider endpoint — the restore span's attribution."""
     tele = telemetry.resolve(telemetry_registry)
     shards: Dict[int, np.ndarray] = {}
     needed: List[int] = []
@@ -202,6 +221,7 @@ async def fetch_shards(
                 client, manifest, i, providers,
                 retries=retries, backoff=backoff, timeout=timeout,
                 store=store, failed_providers=failed_providers, tele=tele,
+                provider_bytes=provider_bytes,
             )
 
     for i, vec in await asyncio.gather(*(one(i) for i in needed)):
@@ -243,11 +263,17 @@ async def sharded_restore(
     manifest = await fetch_manifest(
         client, [ep for ep, _held in providers], digest, timeout=timeout
     )
+    provider_bytes: Dict[str, int] = {}
     shards = await fetch_shards(
         client, manifest, providers,
         parallelism=parallelism, retries=retries, backoff=backoff,
         timeout=timeout, store=store, telemetry_registry=telemetry_registry,
+        provider_bytes=provider_bytes,
     )
+    if stats is not None and provider_bytes:
+        # verified bytes actually pulled per provider — the restore span's
+        # per-provider attribution (fast/slow providers become visible)
+        stats["provider_bytes"] = provider_bytes
     tree = assemble_tree(manifest, shards)
     if store is not None:
         # the resume cache has now served its purpose for this manifest:

@@ -49,7 +49,14 @@ class LocalMetrics(BaseModel):
     # flat {counter/gauge/histogram name: value} — the coordinator folds
     # these into its swarm-health record (telemetry/health.py). Optional so
     # peers with telemetry disabled (and pre-telemetry records) validate.
+    # Per-link estimates ride the same dict as "link.<host:port>.<field>"
+    # keys (telemetry/links.py), bounded to the busiest top-K links.
     telemetry: Optional[Dict[str, float]] = None
+    # this peer's advertised RPC endpoint ("host:port"): lets the
+    # coordinator resolve the link destinations OTHER peers report into
+    # peer labels when folding the swarm topology record. Optional so
+    # pre-link-telemetry records (and client-mode peers) validate.
+    endpoint: Optional[str] = None
     # filled by fetch_metrics from the signed DHT subkey, never by peers:
     # a stable fingerprint so the coordinator can attribute stragglers
     peer: Optional[str] = None

@@ -371,6 +371,12 @@ class TelemetryArguments:
     # metrics bus (LocalMetrics.telemetry) — the coordinator aggregates them
     # into its swarm-health JSONL record
     snapshot_period: float = 30.0
+    # how many per-link estimates (telemetry/links.py: RTT + goodput EWMAs
+    # per destination, busiest first) ride each metrics-bus snapshot and
+    # each link.stats event-log flush — bounds the signed record's size on
+    # large swarms; the coordinator folds these into the swarm topology
+    # record rendered by ``runlog_summary --topology``
+    link_top_k: int = 8
 
 
 @dataclass

@@ -20,8 +20,10 @@ carries handshakes, not tensor bytes.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import socket
 import struct
+import time
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 from dedloc_tpu.core.serialization import pack_obj, unpack_obj
@@ -58,6 +60,37 @@ def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
 
 
 Handler = Callable[[Endpoint, Dict[str, Any]], Awaitable[Any]]
+
+
+def trace_field(tele) -> Optional[list]:
+    """The compact trace context a request frame carries: ``[trace_id,
+    parent_span_id, caller_peer]``, or None when it must carry NOTHING.
+
+    None — and therefore zero extra bytes on the wire framing — whenever
+    telemetry is disabled (``tele is None``) or no trace is live on this
+    task. The receiving ``_dispatch`` adopts the context around the handler
+    so server-side spans record their remote parent; a peer with telemetry
+    off simply ignores the field."""
+    if tele is None:
+        return None
+    tc = telemetry.current_trace()
+    if tc is None:
+        return None
+    return [tc[0], tc[1], tele.peer]
+
+
+# shared no-op: nullcontext is stateless and re-entrant, so the disabled
+# path allocates nothing per dispatch
+_NULL_CM = contextlib.nullcontext()
+
+
+def _adopt_cm(tele, msg):
+    """Context manager adopting a request frame's trace context (no-op when
+    telemetry is off or the frame carries none)."""
+    tc = msg.get("tc")
+    if tele is None or tc is None:
+        return _NULL_CM
+    return telemetry.adopt_trace(tc)
 
 
 def _set_nodelay(writer: asyncio.StreamWriter) -> None:
@@ -131,10 +164,15 @@ class RPCServer:
         rid = self._next_call_id
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending_calls[rid] = (fut, writer)
+        request = {"id": rid, "method": method, "args": args or {}}
+        # trace propagation survives the relay: the relay's _rpc_call runs
+        # inside the ORIGINAL caller's adopted context, so the piped frame
+        # re-carries it (absent — zero bytes — when telemetry is off)
+        tc = trace_field(telemetry.resolve(self.telemetry))
+        if tc is not None:
+            request["tc"] = tc
         try:
-            write_frame(
-                writer, {"id": rid, "method": method, "args": args or {}}
-            )
+            write_frame(writer, request)
             await writer.drain()
             reply = await asyncio.wait_for(fut, timeout=timeout)
         finally:
@@ -223,12 +261,19 @@ class RPCServer:
         try:
             if handler is None:
                 raise KeyError(f"unknown method {method!r}")
-            if getattr(handler, "rpc_wants_writer", False):
-                result = await handler(
-                    tuple(peer[:2]), msg.get("args") or {}, writer
-                )
-            else:
-                result = await handler(tuple(peer[:2]), msg.get("args") or {})
+            # adopt the caller's trace context (frame field "tc") around the
+            # handler: spans opened inside record their REMOTE parent, which
+            # is what lets the coordinator stitch per-peer event logs into
+            # one causal cross-peer round trace
+            with _adopt_cm(tele, msg):
+                if getattr(handler, "rpc_wants_writer", False):
+                    result = await handler(
+                        tuple(peer[:2]), msg.get("args") or {}, writer
+                    )
+                else:
+                    result = await handler(
+                        tuple(peer[:2]), msg.get("args") or {}
+                    )
             reply = {"id": req_id, "ok": True, "result": result}
         except Exception as e:  # noqa: BLE001 — RPC boundary
             logger.debug(f"rpc {method} failed: {e!r}")
@@ -268,9 +313,18 @@ class RPCClient:
         async with lock:
             if endpoint in self._conns:
                 return self._conns[endpoint]
+            t0 = time.perf_counter()
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(*endpoint), timeout=self.request_timeout
             )
+            tele = telemetry.resolve(self.telemetry)
+            if tele is not None:
+                # the TCP handshake is a free SYN/SYN-ACK round trip: the
+                # per-link RTT estimate's "piggybacked ping" (one sample per
+                # pooled connection, zero traffic added to the hot path)
+                tele.links().observe_rtt(
+                    endpoint, time.perf_counter() - t0
+                )
             _set_nodelay(writer)
             self._conns[endpoint] = (reader, writer)
             self._pending[endpoint] = {}
@@ -301,7 +355,8 @@ class RPCClient:
         try:
             if handler is None:
                 raise KeyError(f"unknown relayed method {msg.get('method')!r}")
-            result = await handler(endpoint, msg.get("args") or {})
+            with _adopt_cm(telemetry.resolve(self.telemetry), msg):
+                result = await handler(endpoint, msg.get("args") or {})
             reply = {"id": msg.get("id"), "ok": True, "result": result}
         except Exception as e:  # noqa: BLE001 — RPC boundary
             logger.debug(f"relayed rpc {msg.get('method')} failed: {e!r}")
@@ -492,7 +547,14 @@ class RPCClient:
                     error=type(e).__name__,
                 )
             raise
-        write_frame(writer, {"id": req_id, "method": method, "args": args or {}})
+        request = {"id": req_id, "method": method, "args": args or {}}
+        # cross-peer trace context: [trace_id, parent_span_id, caller peer]
+        # — attached ONLY when telemetry is enabled AND a span is live on
+        # this task, so disabled telemetry adds zero bytes to the framing
+        tc = trace_field(tele)
+        if tc is not None:
+            request["tc"] = tc
+        write_frame(writer, request)
         try:
             await writer.drain()
             reply = await asyncio.wait_for(

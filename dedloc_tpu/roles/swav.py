@@ -277,6 +277,7 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
                 LocalMetrics,
                 publish_metrics,
             )
+            from dedloc_tpu.telemetry.links import endpoint_key
 
             publish_metrics(
                 dht,
@@ -293,6 +294,14 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
                     telemetry=(
                         tele.maybe_snapshot(args.telemetry.snapshot_period)
                         if tele is not None
+                        else None
+                    ),
+                    # advertised RPC endpoint for the coordinator's link →
+                    # peer-label resolution in the swarm topology fold
+                    endpoint=(
+                        endpoint_key(opt.averager.endpoint)
+                        if tele is not None
+                        and opt.averager.endpoint is not None
                         else None
                     ),
                 ),

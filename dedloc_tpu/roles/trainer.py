@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from dedloc_tpu.collaborative.metrics import LocalMetrics, publish_metrics
 from dedloc_tpu.collaborative.optimizer import CollaborativeOptimizer
+from dedloc_tpu.telemetry.links import endpoint_key
 from dedloc_tpu.core.config import CollaborationArguments, parse_config
 from dedloc_tpu.data.streaming import peer_shuffle_seed
 from dedloc_tpu.parallel.train_step import (
@@ -408,6 +409,15 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
                         telemetry=(
                             tele.maybe_snapshot(args.telemetry.snapshot_period)
                             if tele is not None
+                            else None
+                        ),
+                        # advertised RPC endpoint: lets the coordinator
+                        # resolve OTHER peers' link destinations to this
+                        # peer's label in the swarm topology fold
+                        endpoint=(
+                            endpoint_key(opt.averager.endpoint)
+                            if tele is not None
+                            and opt.averager.endpoint is not None
                             else None
                         ),
                     ),

@@ -10,20 +10,25 @@ from __future__ import annotations
 from typing import Optional
 
 from dedloc_tpu.telemetry import registry
-from dedloc_tpu.telemetry.health import build_swarm_health
+from dedloc_tpu.telemetry.health import build_swarm_health, build_topology
+from dedloc_tpu.telemetry.links import LinkTable, endpoint_key
 from dedloc_tpu.telemetry.registry import (
     Counter,
     Gauge,
     Histogram,
     Telemetry,
     active,
+    adopt_trace,
+    current_trace,
     enabled,
     event,
     inc,
     install,
     monotonic_clock,
+    new_span_id,
     resolve,
     span,
+    trace_id_for,
     uninstall,
 )
 
@@ -31,18 +36,25 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "LinkTable",
     "Telemetry",
     "active",
+    "adopt_trace",
     "build_swarm_health",
+    "build_topology",
     "configure",
+    "current_trace",
     "enabled",
+    "endpoint_key",
     "event",
     "inc",
     "install",
     "monotonic_clock",
+    "new_span_id",
     "registry",
     "resolve",
     "span",
+    "trace_id_for",
     "uninstall",
 ]
 
@@ -55,5 +67,9 @@ def configure(args, peer: str = "") -> Optional[Telemetry]:
     if not getattr(args, "enabled", False):
         return None
     return install(
-        Telemetry(peer=peer, event_log_path=args.event_log_path or None)
+        Telemetry(
+            peer=peer,
+            event_log_path=args.event_log_path or None,
+            link_top_k=getattr(args, "link_top_k", 8),
+        )
     )

@@ -64,6 +64,67 @@ def _peer_entry(m, current_step: int) -> Dict:
     return entry
 
 
+def _peer_links(tail: Dict) -> Dict[str, Dict[str, float]]:
+    """Parse a snapshot's flat ``link.<dst>.<field>`` keys (telemetry/links
+    LinkTable.flat) back into per-destination records. Tolerant by
+    construction: snapshots that predate link telemetry simply have no
+    ``link.`` keys and fold to ``{}`` — the peer keeps its ordinary
+    per-peer row, it is never dropped from the fold."""
+    links: Dict[str, Dict[str, float]] = {}
+    for key, value in tail.items():
+        if not isinstance(key, str) or not key.startswith("link."):
+            continue
+        # rsplit once: field names never contain dots, destinations
+        # ("10.0.0.1:31337") routinely do
+        dst, _, field = key[len("link."):].rpartition(".")
+        if not dst or not field:
+            continue
+        try:
+            links.setdefault(dst, {})[field] = float(value)
+        except (TypeError, ValueError):
+            continue
+    return links
+
+
+def build_topology(records) -> Optional[Dict]:
+    """Fold every peer's per-link estimates into ONE swarm topology record:
+    the directed link matrix the hierarchical matchmaker (ROADMAP item 1)
+    reads cliques and fat/thin peers from.
+
+    Shape::
+
+        {"peers": {"<label>": "<host:port>" | None, ...},
+         "links": [{"src": "<label>", "dst": "<label or host:port>",
+                    "dst_endpoint": "<host:port>", "rtt_s": ..,
+                    "goodput_bps": .., "bytes": .., ...}, ...]}
+
+    ``dst`` resolves to a peer label when some record advertises that
+    endpoint (LocalMetrics.endpoint); otherwise the raw endpoint is kept —
+    a link to a peer that never published is still a link. Returns None
+    when NO peer reported link telemetry (old-schema swarm): the health
+    record then simply has no topology, exactly the pre-link view."""
+    peers: Dict[str, Optional[str]] = {}
+    by_endpoint: Dict[str, str] = {}
+    for m in records:
+        endpoint = getattr(m, "endpoint", None)
+        peers[m.peer] = endpoint
+        if endpoint:
+            by_endpoint[endpoint] = m.peer
+    links: List[Dict] = []
+    for m in records:
+        tail = m.telemetry or {}
+        for dst, fields in _peer_links(tail).items():
+            links.append({
+                "src": m.peer,
+                "dst": by_endpoint.get(dst, dst),
+                "dst_endpoint": dst,
+                **fields,
+            })
+    if not links:
+        return None
+    return {"peers": peers, "links": links}
+
+
 def _straggler(peers: List[Dict]) -> Optional[str]:
     """The peer most likely stalling the swarm: deepest behind the current
     step; ties (everyone current) break on the slowest step-phase wall. None
@@ -111,4 +172,9 @@ def build_swarm_health(records) -> Optional[Dict]:
     }
     if formation:
         health["round_formation_s"] = sum(formation) / len(formation)
+    # swarm topology (per-link telemetry): absent — not an error — when no
+    # peer reports link estimates (telemetry off, or a pre-link fleet)
+    topology = build_topology(records)
+    if topology is not None:
+        health["topology"] = topology
     return health

@@ -35,13 +35,16 @@ Event-log schema (one JSON object per line; see docs/observability.md):
 """
 from __future__ import annotations
 
+import contextvars
+import hashlib
 import json
 import math
 import threading
 import time
+import uuid
 from collections import deque
 from contextlib import contextmanager
-from typing import Any, Callable, Deque, Dict, Iterator, List, Optional
+from typing import Any, Callable, Deque, Dict, Iterator, List, Optional, Tuple
 
 from dedloc_tpu.core import timeutils
 from dedloc_tpu.core.timeutils import get_dht_time
@@ -53,6 +56,68 @@ def monotonic_clock() -> float:
     fault scenarios produce deterministic span durations while production
     (offset 0) gets plain ``time.monotonic``."""
     return time.monotonic() + timeutils._dht_time_offset
+
+
+# ---------------------------------------------------------------------------
+# Cross-peer trace context (docs/observability.md "trace propagation").
+#
+# A trace context is ``(trace_id, span_id, peer_label, remote)``: the trace a
+# region belongs to, the span that is its parent, whose registry opened that
+# span, and whether the parent lives on ANOTHER peer (adopted off the RPC
+# framing's compact ``tc`` field). Spans push themselves onto the contextvar
+# for their duration, so nested spans — and RPC requests issued inside them —
+# inherit the linkage; server-side dispatch adopts the caller's context
+# around the handler, so serve spans record their REMOTE parent and the
+# coordinator can stitch per-peer JSONL into one causal round trace.
+#
+# The contextvar is per-task on the event loop and per-thread elsewhere, so
+# concurrent rounds / concurrent handler tasks never cross-link. All of this
+# is only ever touched behind a ``tele is not None`` check: telemetry off
+# pays nothing and the wire framing carries zero extra bytes.
+# ---------------------------------------------------------------------------
+
+_TRACE: contextvars.ContextVar[Optional[Tuple[str, str, str, bool]]] = (
+    contextvars.ContextVar("dedloc_trace", default=None)
+)
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def trace_id_for(seed: str) -> str:
+    """Deterministic trace id from a swarm-unique seed (the round_id): every
+    peer of a round derives the SAME trace id without any wire handshake, so
+    their spans stitch even when a hop's context never propagated (dead
+    leader, dropped frame)."""
+    return hashlib.sha1(seed.encode()).hexdigest()[:16]
+
+
+def current_trace() -> Optional[Tuple[str, str, str, bool]]:
+    """(trace_id, span_id, peer, remote) of the innermost live span, or
+    None. ``RPCClient.call`` reads this to build the frame's ``tc`` field."""
+    return _TRACE.get()
+
+
+@contextmanager
+def adopt_trace(tc) -> Iterator[None]:
+    """Adopt a remote caller's trace context (the ``tc`` list off an RPC
+    request frame: ``[trace_id, parent_span_id, caller_peer]``) for the
+    duration of the handler — spans opened inside record the remote parent.
+    Malformed ``tc`` values are ignored: a hostile or legacy peer must not
+    be able to crash the dispatch path."""
+    try:
+        trace_id, parent_span, caller = (
+            str(tc[0]), str(tc[1]), str(tc[2]) if len(tc) > 2 else "",
+        )
+    except (TypeError, IndexError, KeyError):
+        yield
+        return
+    token = _TRACE.set((trace_id, parent_span, caller, True))
+    try:
+        yield
+    finally:
+        _TRACE.reset(token)
 
 
 class Counter:
@@ -133,6 +198,8 @@ def _jsonable(v: Any) -> Any:
         return v.hex()[:16]
     if isinstance(v, (list, tuple)):
         return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
     return str(v)
 
 
@@ -153,6 +220,7 @@ class Telemetry:
         peer: str = "",
         event_log_path: Optional[str] = None,
         clock: Optional[Callable[[], float]] = None,
+        link_top_k: int = 8,
     ) -> None:
         self.peer = peer
         self.clock = clock or monotonic_clock
@@ -160,6 +228,11 @@ class Telemetry:
         self.gauges: Dict[str, Gauge] = {}
         self.histograms: Dict[str, Histogram] = {}
         self.events: Deque[dict] = deque(maxlen=self.MAX_EVENTS)
+        # per-link network estimator (telemetry/links.py), created on first
+        # observation; ``link_top_k`` bounds how many links ride the metrics
+        # bus snapshot (the busiest first)
+        self.link_top_k = int(link_top_k)
+        self._links = None
         self._lock = threading.Lock()
         # the JSONL mirror gets its OWN lock: a slow disk stalling an event
         # write must not block counter updates on the DHT event loop
@@ -195,13 +268,34 @@ class Telemetry:
                 h = self.histograms[name] = Histogram(self._lock)
             return h
 
+    # --------------------------------------------------------------- links
+
+    def links(self):
+        """This peer's per-link network estimator (telemetry/links.py),
+        created on first use. Instrumented sites must only reach it behind a
+        ``tele is not None`` check — disabled telemetry never allocates it."""
+        if self._links is None:
+            from dedloc_tpu.telemetry.links import LinkTable
+
+            self._links = LinkTable()
+        return self._links
+
     # -------------------------------------------------------------- events
 
     def event(self, name: str, **attrs: Any) -> dict:
-        """Record a point event (and mirror it to the JSONL log)."""
+        """Record a point event (and mirror it to the JSONL log). When a
+        trace context is live (inside a span, or a handler that adopted a
+        remote caller's context) the record gains the linkage fields
+        ``trace`` and ``parent`` — explicit attrs of the same name win (the
+        span exit path passes its own)."""
         record = {"t": get_dht_time(), "peer": self.peer, "event": name}
         for k, v in attrs.items():
             record[k] = _jsonable(v)
+        if "trace" not in record:
+            tc = _TRACE.get()
+            if tc is not None:
+                record["trace"] = tc[0]
+                record["parent"] = tc[1]
         self.events.append(record)  # deque.append is atomic under the GIL
         if self._log is not None:
             line = json.dumps(record) + "\n"
@@ -215,22 +309,52 @@ class Telemetry:
         return record
 
     @contextmanager
-    def span(self, name: str, **attrs: Any) -> Iterator[Dict[str, Any]]:
+    def span(
+        self, name: str, trace_seed: Optional[str] = None, **attrs: Any
+    ) -> Iterator[Dict[str, Any]]:
         """Trace a region: yields a mutable attrs dict the caller can
         annotate with the outcome (``ctx["ok"] = True``); on exit the span
         becomes one event carrying ``dur_s`` and feeds the histogram of the
-        same name."""
+        same name.
+
+        Linkage: every span gets a fresh ``span`` id and records ``trace``
+        and (when nested or remotely called) ``parent``. The trace id is the
+        innermost live context's; with none live it derives from
+        ``trace_seed`` (deterministic — every peer of a round seeds from the
+        same round_id, so their spans stitch without a handshake) or is
+        freshly random. A remote parent (adopted off the RPC framing) also
+        stamps ``caller`` with the calling peer's label. The span is the
+        live context for its duration, so nested spans and outbound RPCs
+        inherit it."""
         ctx: Dict[str, Any] = dict(attrs)
+        span_id = new_span_id()
+        ambient = _TRACE.get()
+        if ambient is not None:
+            trace_id, parent, caller, remote = ambient
+        else:
+            trace_id = (
+                trace_id_for(trace_seed) if trace_seed else new_span_id()
+            )
+            parent, caller, remote = None, "", False
+        linkage: Dict[str, Any] = {"trace": trace_id, "span": span_id}
+        if parent is not None:
+            linkage["parent"] = parent
+        if remote and caller:
+            linkage["caller"] = caller
+        token = _TRACE.set((trace_id, span_id, self.peer, False))
         start = self.clock()
         try:
             yield ctx
         finally:
+            _TRACE.reset(token)
             # clamped at 0: a span that straddles a FakeClock exit sees the
             # clock retreat by the whole fake offset — a huge negative
             # duration would poison the histogram min/mean forever
             dur = max(0.0, self.clock() - start)
             self.histogram(name).observe(dur)
-            self.event(name, dur_s=dur, **ctx)
+            # dict-merge (not double-splat): a caller annotating a key that
+            # collides with the linkage must override, not TypeError
+            self.event(name, dur_s=dur, **{**linkage, **ctx})
 
     # ----------------------------------------------------------- snapshots
 
@@ -249,7 +373,12 @@ class Telemetry:
                     out[f"{name}.count"] = float(h.count)
                     out[f"{name}.mean"] = h.mean
                     out[f"{name}.max"] = h.max
-            return out
+        if self._links is not None:
+            # bounded top-K per-link estimates ride the same flat snapshot
+            # ("link.<host:port>.rtt_s" etc, telemetry/links.py) — the
+            # coordinator folds them into the swarm topology record
+            out.update(self._links.flat(self.link_top_k))
+        return out
 
     def maybe_snapshot(self, period: float) -> Dict[str, float]:
         """Snapshot freshly at most once per ``period`` seconds (the
@@ -269,9 +398,18 @@ class Telemetry:
         ):
             self._last_snapshot_at = now
             self._last_snapshot = self.snapshot()
+            if self._links is not None:
+                # mirror the refreshed link estimates into the event log on
+                # the same throttle (one link.stats event per tracked link)
+                # so ``runlog_summary --topology`` works from JSONL alone
+                self._links.emit_events(self)
         return self._last_snapshot
 
     def close(self) -> None:
+        if self._links is not None:
+            # final link.stats flush: short runs (tests, one-round repros)
+            # may never cross a snapshot period
+            self._links.emit_events(self)
         with self._log_lock:
             if self._log is not None:
                 self._log.close()

@@ -81,33 +81,51 @@ def test_flatten_unflatten_roundtrip(rng):
 async def _allreduce_swarm(vectors, weights, bandwidths, client_mask=None,
                            compression=CompressionType.NONE,
                            chunk_size=DEFAULT_CHUNK_SIZE, dead=(),
-                           straggler_timeout=5.0):
+                           straggler_timeout=5.0, telemetries=None,
+                           round_id="round1", fault_setup=None):
     """Run a full group all-reduce among n in-process peers over loopback
     RPC; returns results. ``dead`` members never run (straggler scenarios —
     pass a short ``straggler_timeout`` to keep those tests fast). Shared
-    with tests/test_wirepath.py — the one swarm harness for the wire path."""
+    with tests/test_wirepath.py and tests/test_tracing.py — the one swarm
+    harness for the wire path.
+
+    ``telemetries`` (optional, one per peer) scopes counters/spans/link
+    estimates per simulated peer; each listening peer then also emits the
+    peer.endpoint self-identification event like a real averager.
+    ``fault_setup(clients, endpoints)`` runs after the sockets exist and
+    before the round — the hook link-level fault injection needs."""
     n = len(vectors)
     client_mask = client_mask or [False] * n
+    telemetries = telemetries or [None] * n
     servers, clients, reducers, endpoints = [], [], [], []
     for i in range(n):
-        client = RPCClient(request_timeout=10.0)
+        client = RPCClient(request_timeout=10.0,
+                           telemetry_registry=telemetries[i])
         server = None
         if not client_mask[i]:
-            server = RPCServer("127.0.0.1", 0)
+            server = RPCServer("127.0.0.1", 0,
+                               telemetry_registry=telemetries[i])
             await server.start()
         clients.append(client)
         servers.append(server)
         reducers.append(GroupAllReduce(client, server, compression=compression,
                                        timeout=10.0,
                                        straggler_timeout=straggler_timeout,
-                                       chunk_size=chunk_size))
+                                       chunk_size=chunk_size,
+                                       telemetry_registry=telemetries[i]))
         endpoints.append(("127.0.0.1", server.port) if server else None)
+        if telemetries[i] is not None and endpoints[i] is not None:
+            telemetries[i].event(
+                "peer.endpoint", endpoint=f"127.0.0.1:{server.port}"
+            )
     eff_bw = [0.0 if client_mask[i] else bandwidths[i] for i in range(n)]
+    if fault_setup is not None:
+        fault_setup(clients, endpoints)
     try:
         results = await asyncio.gather(
             *(
-                reducers[i].run("round1", i, vectors[i], weights[i], endpoints,
-                                eff_bw)
+                reducers[i].run(round_id, i, vectors[i], weights[i],
+                                endpoints, eff_bw)
                 for i in range(n)
                 if i not in dead
             )

@@ -162,3 +162,119 @@ def test_health_view_merges_logs_and_skips_old_schema_rows(tmp_path, capsys):
     assert len(lines) == 2
     assert "step1" in lines[0] and "FAILED" in lines[0]  # earliest t first
     assert "step2" in lines[1]
+
+
+# ------------------------- the one hardened loader (ISSUE 7 satellite)
+
+
+def test_hardened_loader_survives_truncated_tail_and_interleaved_writers(
+    tmp_path, capsys
+):
+    """All telemetry views share load_jsonl_rows: a truncated final line (a
+    peer killed mid-write) is skipped, and a line where two writers jammed
+    their objects together is SPLIT — every complete object is salvaged."""
+    good1 = {"t": 1.0, "peer": "a", "event": "e1"}
+    good2 = {"t": 2.0, "peer": "b", "event": "e2"}
+    good3 = {"t": 3.0, "peer": "a", "event": "e3"}
+    p = tmp_path / "events.jsonl"
+    p.write_text(
+        json.dumps(good1) + "\n"
+        # interleaved writers: two objects jammed onto one line
+        + json.dumps(good2) + json.dumps(good3) + "\n"
+        # garbage prefix before a valid object
+        + 'xx%%' + json.dumps({"t": 4.0, "peer": "c", "event": "e4"}) + "\n"
+        # truncated tail: the peer died mid-write
+        + '{"t": 5.0, "peer": "a", "eve'
+    )
+    rows = runlog_summary.load_jsonl_rows([str(p)])
+    err = capsys.readouterr().err
+    assert [r["event"] for r in rows] == ["e1", "e2", "e3", "e4"]
+    assert "skipped" in err  # the drops are reported, not silent
+
+    events = runlog_summary.load_events([str(p)])
+    assert [r["event"] for r in events] == ["e1", "e2", "e3", "e4"]
+
+
+def test_trace_and_topology_ride_the_same_loader(tmp_path, capsys):
+    """--trace and --topology must not re-grow their own parsers: rows that
+    only the hardened loader can extract (jammed line) appear in both
+    views."""
+    span_id = "a" * 16
+    rows = [
+        {"t": 1.0, "peer": "p0", "event": "peer.endpoint",
+         "endpoint": "127.0.0.1:1"},
+        {"t": 2.0, "peer": "p0", "event": "avg.round", "dur_s": 0.4,
+         "round_id": "step3", "ok": True, "trace": "t" * 16, "span": span_id},
+        {"t": 2.1, "peer": "p1", "event": "mm.join.serve", "dur_s": 0.1,
+         "round_id": "step3", "ok": True, "trace": "t" * 16,
+         "span": "b" * 16, "parent": span_id, "caller": "p0"},
+        {"t": 3.0, "peer": "p1", "event": "link.stats",
+         "dst": "127.0.0.1:1", "rtt_s": 0.02, "goodput_bps": 1000.0,
+         "bytes": 64, "transfers": 2},
+    ]
+    p = tmp_path / "jammed.jsonl"
+    # everything on ONE line: only the raw_decode loader can read this
+    p.write_text("".join(json.dumps(r) for r in rows) + "\n")
+
+    runlog_summary.main(["--trace", "step3", str(p)])
+    out = capsys.readouterr().out
+    assert "mm.join.serve" in out
+    assert "for p0's avg.round" in out  # cross-peer linkage resolved
+
+    runlog_summary.main(["--topology", str(p)])
+    out = capsys.readouterr().out
+    assert "worst link: p1 -> p0" in out
+
+
+def test_topology_degrades_to_allreduce_link_rows(tmp_path, capsys):
+    """Logs from peers killed mid-run hold per-hop allreduce.link rows but
+    no link.stats flush (that happens on the snapshot throttle / close) —
+    --topology must rebuild estimates from the hop rows instead of exiting
+    with 'no link telemetry'."""
+    rows = [
+        {"t": 1.0, "peer": "p0", "event": "peer.endpoint",
+         "endpoint": "127.0.0.1:2"},
+        # p1 -> p0: 1000 wire bytes over 0.001s send wall = fast
+        {"t": 2.0, "peer": "p1", "event": "allreduce.link",
+         "round_id": "step1", "dst": "127.0.0.1:2", "sent_bytes": 1000,
+         "recv_bytes": 1000, "chunks_sent": 2, "chunks_recv": 2,
+         "send_s": 0.001, "wait_s": 0.002, "max_chunk_s": 0.001},
+        # p0 -> p1: same bytes over 0.5s = the slow link
+        {"t": 2.1, "peer": "p0", "event": "allreduce.link",
+         "round_id": "step1", "dst": "127.0.0.1:3", "sent_bytes": 1000,
+         "recv_bytes": 1000, "chunks_sent": 2, "chunks_recv": 2,
+         "send_s": 0.5, "wait_s": 0.6, "max_chunk_s": 0.3},
+    ]
+    runlog_summary.main(["--topology", _write_events(tmp_path, rows)])
+    out = capsys.readouterr().out
+    assert "link matrix" in out
+    assert "worst link: p0 -> 127.0.0.1:3" in out  # unresolved dst kept raw
+    assert "2.0KB/s" in out  # 1000 B / 0.5 s
+
+
+def test_topology_accepts_coordinator_folded_record(tmp_path, capsys):
+    """--topology also renders a coordinator metrics JSONL whose
+    swarm_health.topology already folded the per-peer link views."""
+    row = {
+        "step": 9,
+        "swarm_health": {
+            "current_step": 9,
+            "topology": {
+                "peers": {"aa": "10.0.0.1:7", "bb": "10.0.0.2:7"},
+                "links": [
+                    {"src": "aa", "dst": "bb",
+                     "dst_endpoint": "10.0.0.2:7",
+                     "rtt_s": 0.002, "goodput_bps": 5e6, "bytes": 100},
+                    {"src": "bb", "dst": "aa",
+                     "dst_endpoint": "10.0.0.1:7",
+                     "rtt_s": 0.2, "goodput_bps": 1e3, "bytes": 100},
+                ],
+            },
+        },
+    }
+    p = tmp_path / "coordinator_metrics.jsonl"
+    p.write_text(json.dumps(row) + "\n")
+    runlog_summary.main(["--topology", str(p)])
+    out = capsys.readouterr().out
+    assert "worst link: bb -> aa" in out
+    assert "5.0MB/s" in out

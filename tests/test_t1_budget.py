@@ -46,3 +46,119 @@ def test_report_ranks_and_flags_slow_candidates():
 
 def test_report_without_durations_explains():
     assert "--durations=0" in t1_budget.report([])
+
+
+# ------------------------------------------------- --gate regression mode
+
+
+def test_gate_passes_within_tolerance_and_fails_on_regression():
+    rows = t1_budget.parse_durations(_LOG.splitlines())
+    # measured: test_leader_death = 12.3s, test_quick = 3.0s
+    ok_baseline = {
+        "tests/test_faults.py::test_leader_death": 11.0,  # +12% < 25%
+        "tests/test_core.py::test_quick": 3.0,
+    }
+    text, code = t1_budget.gate(rows, ok_baseline, tolerance=0.25)
+    assert code == 0
+    assert "gate passed: 2/2" in text
+
+    # 12.3s vs 6.0s baseline = 2.05x — over 25% + 1s slack
+    bad_baseline = {"tests/test_faults.py::test_leader_death": 6.0}
+    text, code = t1_budget.gate(rows, bad_baseline, tolerance=0.25)
+    assert code == 1
+    assert "GATE FAILED" in text
+    assert "test_leader_death" in text
+    assert "2.05x" in text
+
+
+def test_gate_absolute_slack_absorbs_subsecond_jitter():
+    """A 0.2s test measuring 0.5s is a 2.5x 'regression' — but the absolute
+    slack keeps sub-second noise from wedging CI."""
+    rows = [("tests/test_x.py::test_tiny", "call", 0.5)]
+    text, code = t1_budget.gate(
+        rows, {"tests/test_x.py::test_tiny": 0.2}, tolerance=0.25,
+        slack_s=1.0,
+    )
+    assert code == 0
+    text, code = t1_budget.gate(
+        rows, {"tests/test_x.py::test_tiny": 0.2}, tolerance=0.25,
+        slack_s=0.0,
+    )
+    assert code == 1
+
+
+def test_gate_warns_but_does_not_fail_on_missing_tests():
+    rows = t1_budget.parse_durations(_LOG.splitlines())
+    baseline = {
+        "tests/test_core.py::test_quick": 3.0,
+        "tests/test_gone.py::test_renamed_away": 5.0,
+    }
+    text, code = t1_budget.gate(rows, baseline)
+    assert code == 0
+    assert "warning" in text and "test_renamed_away" in text
+
+
+def test_record_baseline_roundtrips_into_gate():
+    rows = t1_budget.parse_durations(_LOG.splitlines())
+    baseline = t1_budget.record_baseline(rows, [])
+    assert baseline["tests/test_faults.py::test_leader_death"] == 12.3
+    _text, code = t1_budget.gate(rows, baseline)
+    assert code == 0  # a freshly recorded baseline always passes
+
+
+def test_gate_zero_baseline_fails_with_report_not_zerodivision():
+    """A 0.0 baseline entry (legal JSON) must produce the GATE FAILED
+    report, never an unhandled ZeroDivisionError that loses the output."""
+    rows = [("tests/test_x.py::test_t", "call", 2.0)]
+    text, code = t1_budget.gate(
+        rows, {"tests/test_x.py::test_t": 0.0}, slack_s=1.0
+    )
+    assert code == 1
+    assert "GATE FAILED" in text and "baseline 0" in text
+
+
+def test_record_baseline_floors_subsecond_and_respects_curation(tmp_path):
+    """record_baseline floors values at 0.01 (a rounded-to-0.0 entry would
+    gate on slack alone), and --record-baseline over an EXISTING file
+    refreshes only its curated tests instead of swallowing the suite."""
+    rows = [("tests/test_a.py::test_tiny", "call", 0.004),
+            ("tests/test_a.py::test_other", "call", 5.0)]
+    assert t1_budget.record_baseline(rows, [])[
+        "tests/test_a.py::test_tiny"] == 0.01
+    # selective: only the named test is recorded
+    only = t1_budget.record_baseline(rows, ["tests/test_a.py::test_tiny"])
+    assert list(only) == ["tests/test_a.py::test_tiny"]
+
+    import json
+
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"tests/test_a.py::test_other": 4.0}))
+    log = tmp_path / "t1.log"
+    log.write_text(
+        "5.00s call     tests/test_a.py::test_other\n"
+        "0.50s call     tests/test_a.py::test_tiny\n"
+    )
+    t1_budget.main(["--record-baseline", str(path), str(log)])
+    refreshed = json.loads(path.read_text())
+    assert refreshed == {"tests/test_a.py::test_other": 5.0}
+
+    # bootstrap: a missing file records everything
+    fresh = tmp_path / "fresh.json"
+    t1_budget.main(["--record-baseline", str(fresh), str(log)])
+    assert set(json.loads(fresh.read_text())) == {
+        "tests/test_a.py::test_other", "tests/test_a.py::test_tiny"
+    }
+
+
+def test_repo_baseline_file_covers_this_prs_tests():
+    """The committed baseline must name this PR's new tier-1 tests so the
+    gate can catch them regressing (ISSUE 7 satellite)."""
+    baseline_path = (
+        Path(__file__).resolve().parent.parent / "tools" / "t1_baseline.json"
+    )
+    import json
+
+    baseline = json.loads(baseline_path.read_text())
+    assert any("test_tracing.py" in k for k in baseline)
+    assert all(isinstance(v, (int, float)) and v > 0
+               for v in baseline.values())

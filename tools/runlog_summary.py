@@ -1,9 +1,11 @@
 """Summarize a trainer train_log.jsonl into the BASELINE.md table format,
-or render swarm-health views from telemetry event logs.
+or render swarm views from telemetry event logs.
 
 Usage:
     python tools/runlog_summary.py train_log.jsonl [step step ...]
     python tools/runlog_summary.py --health events.jsonl [events2.jsonl ...]
+    python tools/runlog_summary.py --trace ROUND_ID events.jsonl [...]
+    python tools/runlog_summary.py --topology events.jsonl [...]
 
 Default mode prints a markdown `| global step | wall (min) | loss |` table at
 the given checkpoints (default: a log-spaced selection plus the final step)
@@ -16,6 +18,26 @@ several peers' logs can be merged in one invocation — and renders the round
 timeline plus a per-peer fault/retry table: which rounds ran, how long each
 took, who injected/suffered faults, who retried state syncs, whose joins
 failed.
+
+``--trace ROUND_ID`` stitches every peer's events for ONE round into a
+cross-peer causal timeline using the trace-context linkage fields
+(``trace``/``span``/``parent``/``caller``, threaded through the RPC framing
+— docs/observability.md "Cross-peer trace propagation"): who waited on whom
+across RPC hops, per-hop wire vs reduce vs straggler time, the critical
+path (the slowest link, not just the slowest peer), and any ORPHANED spans
+whose parent never appears in the collected logs (a peer that died
+mid-round, or whose log was not collected).
+
+``--topology`` renders the swarm link matrix from per-link telemetry
+(``link.stats`` / ``allreduce.link`` / ``peer.endpoint`` events; it also
+accepts a coordinator metrics JSONL whose ``swarm_health.topology`` record
+already folded the per-peer views): per-link RTT/goodput estimates ranked
+worst-first, low-RTT clique candidates, and fat/thin peers — the input the
+hierarchical matchmaker reads (ROADMAP item 1).
+
+All three telemetry views share ONE hardened loader: truncated final lines
+(a peer killed mid-write) and interleaved/jammed lines (two writers on one
+file) are skipped or split, never fatal.
 """
 from __future__ import annotations
 
@@ -72,33 +94,56 @@ def percentiles(values):
     return pct(0.50), pct(0.90), pct(0.99)
 
 
-# --------------------------------------------------------------- health view
+# -------------------------------------------------------- telemetry loaders
 # (telemetry event-log schema: {"t", "peer", "event", "dur_s"?, ...attrs};
 # docs/observability.md. Tolerates rows from older emitters — any line with
 # an "event" key renders, unknown events just count toward totals.)
 
 
-def load_events(paths):
+def load_jsonl_rows(paths):
+    """THE hardened JSONL loader every telemetry view (--health, --trace,
+    --topology) goes through. Tolerates the two corruptions real fleet logs
+    actually have:
+
+    - a truncated final line (the peer was killed mid-write — the very
+      churn these views exist to debug): the fragment is skipped;
+    - interleaved writers (two processes appending the same file can jam
+      two objects onto one line, or splice one object into another): each
+      line is decoded object-by-object with ``raw_decode``, salvaging every
+      complete object and counting only the garbage between them.
+
+    Returns all decoded dict rows in file order; callers filter."""
     rows = []
     dropped = 0
+    decoder = json.JSONDecoder()
     for path in paths:
-        with open(path, encoding="utf-8") as f:
+        with open(path, encoding="utf-8", errors="replace") as f:
             for line in f:
-                if not line.strip():
-                    continue
-                try:
-                    row = json.loads(line)
-                except json.JSONDecodeError:
-                    # a peer killed mid-write (scripted churn, leader death —
-                    # the very runs this tool renders) leaves a truncated
-                    # final line; skip it, don't die on it
-                    dropped += 1
-                    continue
-                if "event" in row:
-                    rows.append(row)
+                line = line.strip()
+                while line:
+                    start = line.find("{")
+                    if start < 0:
+                        dropped += 1  # no object on what remains
+                        break
+                    if start > 0:
+                        dropped += 1  # leading garbage before the object
+                    try:
+                        obj, end = decoder.raw_decode(line, start)
+                    except json.JSONDecodeError:
+                        dropped += 1  # truncated/spliced fragment
+                        break
+                    if isinstance(obj, dict):
+                        rows.append(obj)
+                    line = line[end:].strip()
     if dropped:
-        print(f"warning: skipped {dropped} unparseable line(s)",
+        print(f"warning: skipped {dropped} unparseable fragment(s)",
               file=sys.stderr)
+    return rows
+
+
+def load_events(paths):
+    """Event rows (telemetry schema), merged across peers, time-ordered."""
+    rows = [r for r in load_jsonl_rows(paths) if "event" in r]
     rows.sort(key=lambda r: r.get("t", 0.0))
     return rows
 
@@ -252,11 +297,368 @@ def print_health(rows):
         )
 
 
+# ---------------------------------------------------------------- trace view
+# (cross-peer causal timeline for ONE round, stitched over the linkage
+# fields the trace-context propagation writes: docs/observability.md)
+
+
+def _endpoint_map(rows):
+    """{endpoint: peer label} from peer.endpoint self-identification
+    events — resolves the link destinations peers report into labels."""
+    out = {}
+    for r in rows:
+        if r.get("event") == "peer.endpoint" and r.get("endpoint"):
+            out[str(r["endpoint"])] = r.get("peer", "?")
+    return out
+
+
+def _fmt_dst(dst, ep_map):
+    peer = ep_map.get(str(dst))
+    return f"{peer} ({dst})" if peer else str(dst)
+
+
+def _round_matches(round_id, round_key):
+    """Exact round match. Round ids are either the bare optimizer key
+    ("step17") or the averager's composite allreduce form
+    ("prefix:step17:nonce") — match whole ``:``-separated segments, never
+    substrings, or ``--trace step1`` would swallow step10..step19 and
+    print a multi-round chimera."""
+    rid = str(round_id)
+    return rid == round_key or round_key in rid.split(":")
+
+
+def select_trace(rows, round_key):
+    """Rows belonging to one round's cross-peer trace: everything whose
+    round_id matches, plus everything sharing those rows' trace ids
+    (server-side serve spans carry the trace but not always the round)."""
+    matched = [r for r in rows if _round_matches(r.get("round_id", ""), round_key)]
+    traces = {r["trace"] for r in matched if r.get("trace")}
+    if traces:
+        return [
+            r for r in rows
+            if r.get("trace") in traces
+            or _round_matches(r.get("round_id", ""), round_key)
+        ], traces
+    return matched, traces
+
+
+def print_trace(rows, round_key):
+    trace_rows, traces = select_trace(rows, round_key)
+    if not trace_rows:
+        sys.exit(
+            f"no events for round {round_key!r} (is --telemetry.enabled "
+            "set, and are these the right event logs?)"
+        )
+    ep_map = _endpoint_map(rows)
+    peers = sorted({r.get("peer", "?") for r in trace_rows})
+    print(f"round {round_key}: {len(trace_rows)} events from "
+          f"{len(peers)} peer(s) {peers}, "
+          f"trace {sorted(traces) if traces else '(no linkage fields)'}")
+
+    spans = {r["span"]: r for r in trace_rows if r.get("span")}
+    t0 = min(r.get("t", 0.0) for r in trace_rows)
+    print("\ntimeline (cross-peer, causal):")
+    for r in sorted(trace_rows, key=lambda r: r.get("t", 0.0)):
+        dur = f" dur={r['dur_s']:.3f}s" if "dur_s" in r else ""
+        parent = r.get("parent")
+        linked = ""
+        if parent:
+            parent_row = spans.get(parent)
+            if parent_row is not None and parent_row.get("peer") != r.get("peer"):
+                # a remote parent: this row happened ON BEHALF of another
+                # peer's span — the who-waited-on-whom arrow
+                linked = f"  ← for {parent_row.get('peer', '?')}'s " \
+                         f"{parent_row.get('event', '?')}"
+            elif parent_row is None and r.get("caller"):
+                linked = f"  ← for {r['caller']} (parent span not collected)"
+        ok = r.get("ok")
+        flag = "" if ok is None else (" ok" if ok else " FAILED")
+        extra = ""
+        if r.get("event") == "allreduce.link":
+            extra = (
+                f" dst={_fmt_dst(r.get('dst'), ep_map)}"
+                f" wait={r.get('wait_s', 0.0):.3f}s"
+                f" send={r.get('send_s', 0.0):.3f}s"
+                f" bytes={int(r.get('sent_bytes', 0) + r.get('recv_bytes', 0))}"
+            )
+        elif r.get("event") == "allreduce.stragglers":
+            extra = f" missing={r.get('missing')}"
+        print(
+            f"  +{r.get('t', 0.0) - t0:7.3f}s  {r.get('peer', '?'):<12} "
+            f"{r.get('event', '?'):<20}{dur}{flag}{extra}{linked}"
+        )
+
+    # per-hop attribution: every member's allreduce.link rows say how long
+    # it waited on each link; the host-side allreduce.round spans say how
+    # much of a round was reduce CPU; straggler events mark SLA waits
+    hops = [r for r in trace_rows if r.get("event") == "allreduce.link"]
+    if hops:
+        print("\nper-hop wire time:")
+        print("| src | dst | chunks | bytes | send | wait | max chunk |")
+        print("|---|---|---|---|---|---|---|")
+        for r in sorted(hops, key=lambda r: -float(r.get("wait_s", 0.0))):
+            print(
+                f"| {r.get('peer', '?')} | {_fmt_dst(r.get('dst'), ep_map)} |"
+                f" {int(r.get('chunks_sent', 0) + r.get('chunks_recv', 0))} |"
+                f" {int(r.get('sent_bytes', 0) + r.get('recv_bytes', 0))} |"
+                f" {r.get('send_s', 0.0):.3f}s | {r.get('wait_s', 0.0):.3f}s |"
+                f" {r.get('max_chunk_s', 0.0):.3f}s |"
+            )
+        worst = max(hops, key=lambda r: float(r.get("wait_s", 0.0)))
+        reduce_total = sum(
+            float(r.get("reduce_s", 0.0)) for r in trace_rows
+            if r.get("event") == "allreduce.round"
+        )
+        stragglers = [
+            r for r in trace_rows if r.get("event") == "allreduce.stragglers"
+        ]
+        print(
+            f"\ncritical path: {worst.get('peer', '?')} waited "
+            f"{float(worst.get('wait_s', 0.0)):.3f}s on link "
+            f"{worst.get('peer', '?')} -> {_fmt_dst(worst.get('dst'), ep_map)}"
+            f" (wire); reduce CPU across hosts {reduce_total:.3f}s"
+            + (
+                f"; straggler SLA waits: "
+                f"{[r.get('missing') for r in stragglers]}"
+                if stragglers else ""
+            )
+        )
+
+    # orphaned spans: a parent id that appears in NO collected log — the
+    # parent peer died mid-round or its log was never collected. Reported,
+    # never silently dropped: the orphan is exactly where the causal chain
+    # broke.
+    orphans = [
+        r for r in trace_rows
+        if r.get("parent") and r["parent"] not in spans
+    ]
+    if orphans:
+        print(f"\norphaned spans ({len(orphans)}): parent span never "
+              "collected (peer died mid-round, or its log is missing)")
+        for r in orphans:
+            caller = f" caller={r['caller']}" if r.get("caller") else ""
+            print(
+                f"  {r.get('peer', '?'):<12} {r.get('event', '?'):<20} "
+                f"parent={r['parent']}{caller}"
+            )
+
+
+# ------------------------------------------------------------- topology view
+# (per-link RTT/goodput matrix: link.stats events per peer, or a
+# coordinator metrics JSONL whose swarm_health.topology already folded them)
+
+
+def _links_from_events(rows):
+    """[{src, dst, rtt_s?, goodput_bps?, ...}] from per-peer link.stats
+    events (latest per (src, dst) wins — they are cumulative estimates).
+
+    Degraded mode: logs from peers killed mid-run (the crash/churn
+    scenarios this tool debugs) may hold NO link.stats flush — estimates
+    are then rebuilt from the per-round allreduce.link rows: goodput =
+    scattered wire bytes over pure send wall, aggregated per (src, dst)."""
+    latest = {}
+    for r in rows:
+        if r.get("event") == "link.stats" and r.get("dst"):
+            latest[(r.get("peer", "?"), str(r["dst"]))] = r
+    if latest:
+        out = []
+        for (src, dst), r in sorted(latest.items()):
+            link = {"src": src, "dst": dst}
+            for key in ("rtt_s", "goodput_bps", "bytes", "transfers",
+                        "chunk_p50_s", "chunk_max_s"):
+                if key in r:
+                    link[key] = float(r[key])
+            out.append(link)
+        return out
+    acc = {}
+    for r in rows:
+        if r.get("event") != "allreduce.link" or not r.get("dst"):
+            continue
+        a = acc.setdefault(
+            (r.get("peer", "?"), str(r["dst"])),
+            {"bytes": 0.0, "send_s": 0.0, "transfers": 0.0,
+             "chunk_max_s": 0.0},
+        )
+        a["bytes"] += float(r.get("sent_bytes", 0.0))
+        a["send_s"] += float(r.get("send_s", 0.0))
+        a["transfers"] += float(r.get("chunks_sent", 0.0))
+        a["chunk_max_s"] = max(
+            a["chunk_max_s"], float(r.get("max_chunk_s", 0.0))
+        )
+    out = []
+    for (src, dst), a in sorted(acc.items()):
+        link = {"src": src, "dst": dst, "bytes": a["bytes"],
+                "transfers": a["transfers"],
+                "chunk_max_s": a["chunk_max_s"]}
+        if a["bytes"] > 0 and a["send_s"] > 0:
+            link["goodput_bps"] = a["bytes"] / a["send_s"]
+        out.append(link)
+    return out
+
+
+def _link_sort_key(link):
+    """Worst link first: lowest goodput, then slowest median chunk, then
+    highest RTT. Links with no goodput sample yet sort after measured
+    ones — an unmeasured link is unknown, not slow."""
+    goodput = link.get("goodput_bps")
+    return (
+        0 if goodput is not None else 1,
+        goodput if goodput is not None else 0.0,
+        -float(link.get("chunk_p50_s", 0.0)),
+        -float(link.get("rtt_s", 0.0)),
+    )
+
+
+def _fmt_rate(bps):
+    if bps is None:
+        return "-"
+    if bps >= 1e6:
+        return f"{bps / 1e6:.1f}MB/s"
+    if bps >= 1e3:
+        return f"{bps / 1e3:.1f}KB/s"
+    return f"{bps:.0f}B/s"
+
+
+def print_topology(all_rows):
+    # a coordinator metrics JSONL already carries the folded record: use the
+    # newest; otherwise fold per-peer link.stats events here
+    folded = [
+        r["swarm_health"]["topology"] for r in all_rows
+        if isinstance(r.get("swarm_health"), dict)
+        and r["swarm_health"].get("topology")
+    ]
+    event_rows = [r for r in all_rows if "event" in r]
+    ep_map = _endpoint_map(event_rows)
+    if folded:
+        topo = folded[-1]
+        links = [dict(l) for l in topo.get("links", [])]
+        for label, endpoint in (topo.get("peers") or {}).items():
+            if endpoint:
+                ep_map.setdefault(str(endpoint), label)
+    else:
+        links = _links_from_events(event_rows)
+    if not links:
+        sys.exit(
+            "no link telemetry found (links appear after the first "
+            "snapshot/close flush — is --telemetry.enabled set?)"
+        )
+    for link in links:
+        link["dst_label"] = ep_map.get(str(link.get("dst")), str(link.get("dst")))
+
+    print("link matrix (src -> dst: rtt / goodput):")
+    srcs = sorted({l["src"] for l in links})
+    dsts = sorted({l["dst_label"] for l in links})
+    by_pair = {(l["src"], l["dst_label"]): l for l in links}
+    print("| src \\ dst | " + " | ".join(dsts) + " |")
+    print("|---" * (len(dsts) + 1) + "|")
+    for src in srcs:
+        cells = []
+        for dst in dsts:
+            link = by_pair.get((src, dst))
+            if link is None:
+                cells.append("-")
+            else:
+                rtt = link.get("rtt_s")
+                rtt_s = f"{rtt * 1e3:.1f}ms" if rtt is not None else "-"
+                cells.append(f"{rtt_s} / {_fmt_rate(link.get('goodput_bps'))}")
+        print(f"| {src} | " + " | ".join(cells) + " |")
+
+    print("\nlinks, worst first:")
+    print("| src | dst | rtt | goodput | chunk p50 | chunk max | bytes |")
+    print("|---|---|---|---|---|---|---|")
+    ranked = sorted(links, key=_link_sort_key)
+    for link in ranked:
+        rtt = link.get("rtt_s")
+        print(
+            f"| {link['src']} | {link['dst_label']} |"
+            f" {f'{rtt * 1e3:.1f}ms' if rtt is not None else '-'} |"
+            f" {_fmt_rate(link.get('goodput_bps'))} |"
+            f" {link.get('chunk_p50_s', 0.0):.3f}s |"
+            f" {link.get('chunk_max_s', 0.0):.3f}s |"
+            f" {int(link.get('bytes', 0))} |"
+        )
+    worst = ranked[0]
+    print(
+        f"\nworst link: {worst['src']} -> {worst['dst_label']} "
+        f"(goodput {_fmt_rate(worst.get('goodput_bps'))}, "
+        f"chunk p50 {worst.get('chunk_p50_s', 0.0):.3f}s)"
+    )
+
+    # clique candidates: peers whose pairwise RTT sits well under the swarm
+    # median are same-datacenter material — the hierarchical matchmaker's
+    # local-reduction groups (ROADMAP item 1)
+    rtts = sorted(
+        l["rtt_s"] for l in links if l.get("rtt_s") is not None
+    )
+    if len(rtts) >= 2:
+        median_rtt = rtts[len(rtts) // 2]
+        fast_pairs = [
+            (l["src"], l["dst_label"]) for l in links
+            if l.get("rtt_s") is not None and l["rtt_s"] <= 0.5 * median_rtt
+        ]
+        if fast_pairs:
+            # union-find over low-RTT pairs
+            parent = {}
+
+            def find(x):
+                parent.setdefault(x, x)
+                while parent[x] != x:
+                    parent[x] = parent[parent[x]]
+                    x = parent[x]
+                return x
+
+            for a, b in fast_pairs:
+                parent[find(a)] = find(b)
+            cliques = {}
+            for node in parent:
+                cliques.setdefault(find(node), set()).add(node)
+            groups = [sorted(c) for c in cliques.values() if len(c) >= 2]
+            if groups:
+                print(
+                    "\nclique candidates (pairwise RTT <= 0.5x median "
+                    f"{median_rtt * 1e3:.1f}ms):"
+                )
+                for group in sorted(groups):
+                    print(f"  {group}")
+
+    # fat/thin peers: aggregate goodput of the links INTO each peer — the
+    # degenerate-strategy signal (a few fat peers become de-facto parameter
+    # servers for thin client-mode volunteers)
+    inbound = {}
+    for l in links:
+        if l.get("goodput_bps") is not None:
+            inbound.setdefault(l["dst_label"], []).append(l["goodput_bps"])
+    if len(inbound) >= 2:
+        means = {p: sum(v) / len(v) for p, v in inbound.items()}
+        ordered = sorted(means.values())
+        median = ordered[len(ordered) // 2]
+        fat = [p for p, m in means.items() if m >= 2.0 * median]
+        thin = [p for p, m in means.items() if m <= 0.5 * median]
+        if fat or thin:
+            print("\nfat/thin peers (mean inbound-link goodput vs median):")
+            for p in sorted(fat):
+                print(f"  fat:  {p} ({_fmt_rate(means[p])})")
+            for p in sorted(thin):
+                print(f"  thin: {p} ({_fmt_rate(means[p])})")
+
+
 def main(argv):
     if argv and argv[0] == "--health":
         if not argv[1:]:
             sys.exit("usage: runlog_summary.py --health events.jsonl [...]")
         print_health(load_events(argv[1:]))
+        return
+    if argv and argv[0] == "--trace":
+        if len(argv) < 3:
+            sys.exit(
+                "usage: runlog_summary.py --trace ROUND_ID events.jsonl [...]"
+            )
+        print_trace(load_events(argv[2:]), argv[1])
+        return
+    if argv and argv[0] == "--topology":
+        if not argv[1:]:
+            sys.exit("usage: runlog_summary.py --topology events.jsonl [...]")
+        print_topology(load_jsonl_rows(argv[1:]))
         return
     rows = load(argv[0])
     if not rows:

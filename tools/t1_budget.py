@@ -13,13 +13,27 @@ Usage::
     python tools/t1_budget.py /tmp/_t1.log
     python tools/t1_budget.py --cap 870 --top 25 --slow-threshold 10 /tmp/_t1.log
 
+    # CI gate: exit nonzero when a baselined test regressed >25%
+    python tools/t1_budget.py --gate tools/t1_baseline.json /tmp/_t1.log
+    # refresh the baseline from a trusted idle-box run
+    python tools/t1_budget.py --record-baseline tools/t1_baseline.json /tmp/_t1.log
+
 Reads stdin when no file is given. Only stdlib, no pytest plugin — it
 parses the human-readable durations block, so it also works on archived CI
 logs.
+
+``--gate`` compares each test named in the baseline JSON (``{"test id":
+seconds}``) against the log's measured total and exits nonzero when any
+regressed more than ``--gate-tolerance`` (default 0.25 = +25%) beyond a
+small absolute slack (``--gate-slack``, default 1s — sub-second tests jitter
+by whole multiples on a loaded box). Tests in the baseline but absent from
+the log are reported as warnings, not failures (a deselected or renamed test
+must not wedge CI, but it must not vanish silently either).
 """
 from __future__ import annotations
 
 import argparse
+import json
 import re
 import sys
 from collections import defaultdict
@@ -97,6 +111,82 @@ def report(
     return "\n".join(out)
 
 
+def gate(
+    rows,
+    baseline: Dict[str, float],
+    tolerance: float = 0.25,
+    slack_s: float = 1.0,
+) -> Tuple[str, int]:
+    """Compare measured per-test totals against a recorded baseline.
+
+    Returns (report text, exit code): 0 when every baselined test that ran
+    stayed within ``baseline * (1 + tolerance) + slack_s``, 1 when any
+    regressed past it. Tests missing from the log only warn — but they DO
+    warn, so a silent rename/deselection stays visible."""
+    per_test, _per_file = aggregate(rows)
+    out: List[str] = []
+    regressed: List[Tuple[str, float, float]] = []
+    missing: List[str] = []
+    for test_id, base_s in sorted(baseline.items()):
+        measured = per_test.get(test_id)
+        if measured is None:
+            missing.append(test_id)
+            continue
+        limit = float(base_s) * (1.0 + tolerance) + slack_s
+        if measured > limit:
+            regressed.append((test_id, float(base_s), measured))
+        else:
+            out.append(
+                f"ok: {test_id}  {measured:.1f}s (baseline {base_s:.1f}s, "
+                f"limit {limit:.1f}s)"
+            )
+    for test_id in missing:
+        out.append(
+            f"warning: baselined test not in this log (deselected or "
+            f"renamed?): {test_id}"
+        )
+    if regressed:
+        out.append("")
+        out.append(
+            f"GATE FAILED: {len(regressed)} test(s) regressed more than "
+            f"{tolerance * 100:.0f}% (+{slack_s:.1f}s slack) vs baseline — "
+            "the 870s overrun must not silently worsen "
+            "(memory/tier1-timing-budget.md):"
+        )
+        for test_id, base_s, measured in regressed:
+            # a 0.0 baseline (legal JSON, and what rounding a sub-5ms test
+            # would produce) must fail with a report, not a ZeroDivisionError
+            ratio = (
+                f"{measured / base_s:.2f}x" if base_s > 0 else "baseline 0"
+            )
+            out.append(
+                f"  {test_id}: {measured:.1f}s vs baseline {base_s:.1f}s "
+                f"({ratio})"
+            )
+        return "\n".join(out), 1
+    out.append("")
+    out.append(
+        f"gate passed: {len(baseline) - len(missing)}/{len(baseline)} "
+        "baselined tests within budget"
+    )
+    return "\n".join(out), 0
+
+
+def record_baseline(rows, tests: List[str]) -> Dict[str, float]:
+    """Measured totals for ``tests`` (all parsed tests when empty) — the
+    JSON written back as the next baseline. Values floor at 0.01s so a
+    recorded baseline can never round to the 0.0 the gate treats as an
+    unconditional (slack-only) budget."""
+    per_test, _ = aggregate(rows)
+    if tests:
+        picked = {t: per_test[t] for t in tests if t in per_test}
+    else:
+        picked = per_test
+    return {
+        t: max(0.01, round(s, 2)) for t, s in sorted(picked.items())
+    }
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("log", nargs="?", help="pytest log (default: stdin)")
@@ -106,12 +196,54 @@ def main(argv=None) -> None:
     parser.add_argument("--slow-threshold", type=float, default=10.0,
                         help="per-test seconds above which to suggest a "
                              "slow mark")
+    parser.add_argument("--gate", metavar="BASELINE_JSON",
+                        help="compare against a recorded baseline and exit "
+                             "nonzero on a >tolerance regression")
+    parser.add_argument("--gate-tolerance", type=float, default=0.25,
+                        help="fractional regression allowed vs baseline "
+                             "(0.25 = +25%%)")
+    parser.add_argument("--gate-slack", type=float, default=1.0,
+                        help="absolute seconds of slack on top of the "
+                             "tolerance (sub-second tests jitter in whole "
+                             "multiples)")
+    parser.add_argument("--record-baseline", metavar="BASELINE_JSON",
+                        help="re-record measured totals into this JSON and "
+                             "exit: an existing file keeps its curated test "
+                             "set (values refreshed only), a new file "
+                             "records every parsed test")
     args = parser.parse_args(argv)
     if args.log:
         with open(args.log, encoding="utf-8", errors="replace") as f:
             rows = parse_durations(f)
     else:
         rows = parse_durations(sys.stdin)
+    if args.record_baseline:
+        # refreshing an EXISTING baseline re-records only the tests it
+        # already curates — a full-suite durations log must not replace a
+        # hand-picked gate set with hundreds of entries. A new file records
+        # everything (the bootstrap case).
+        curated: List[str] = []
+        try:
+            with open(args.record_baseline, encoding="utf-8") as f:
+                curated = list(json.load(f))
+        except (OSError, ValueError):
+            pass
+        baseline = record_baseline(rows, curated)
+        with open(args.record_baseline, "w", encoding="utf-8") as f:
+            json.dump(baseline, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"recorded {len(baseline)} test durations to "
+              f"{args.record_baseline}")
+        return
+    if args.gate:
+        with open(args.gate, encoding="utf-8") as f:
+            baseline = json.load(f)
+        text, code = gate(
+            rows, baseline, tolerance=args.gate_tolerance,
+            slack_s=args.gate_slack,
+        )
+        print(text)
+        sys.exit(code)
     print(report(rows, cap=args.cap, top=args.top,
                  slow_threshold=args.slow_threshold))
 
