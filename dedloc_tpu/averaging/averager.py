@@ -125,6 +125,10 @@ class DecentralizedAverager:
         # (one peer per process) leaves None and the process-global
         # registry — if installed — is used at each instrumented site
         telemetry_registry=None,
+        # dht/transport.py seam for this peer's averaging RPC server and
+        # client: None = real TCP (production); the swarm simulator injects
+        # its in-process network here
+        transport=None,
     ):
         if relay and not client_mode:
             # a listening peer IS a relay; accepting (and dropping) the flag
@@ -193,10 +197,12 @@ class DecentralizedAverager:
                 self.client = RPCClient(
                     request_timeout=averaging_timeout,
                     telemetry_registry=self.telemetry,
+                    transport=transport,
                 )
                 if not client_mode:
                     self.server = RPCServer(
-                        *self._listen, telemetry_registry=self.telemetry
+                        *self._listen, telemetry_registry=self.telemetry,
+                        transport=transport,
                     )
                     self.server.register("state.get", self._rpc_state_get)
                     # swarm checkpointing: serve the sharded form of the

@@ -118,11 +118,15 @@ async def _fetch_one_shard(
         pool = pool or candidates
         ep = pool[attempt % len(pool)]
         try:
-            t0 = time.perf_counter()
+            # monotonic_clock (not perf_counter): advances with the
+            # fake-clock offset, so a simulated transfer's goodput reflects
+            # the MODELED link, not host execution noise; production
+            # (offset 0) reads identically to a raw monotonic clock
+            t0 = telemetry.monotonic_clock()
             reply = await client.call(
                 ep, "ckpt.shard", {"index": index}, timeout=timeout
             )
-            fetch_s = time.perf_counter() - t0
+            fetch_s = max(0.0, telemetry.monotonic_clock() - t0)
             raw = np.ascontiguousarray(
                 deserialize_array(reply["data"]), dtype=np.float32
             ).tobytes()

@@ -14,20 +14,38 @@ DHTExpiration = float  # absolute unix timestamp after which a record is dead
 MAX_DHT_TIME_DISCREPANCY = 3.0
 
 _dht_time_offset = 0.0
+# optional full override of the clock (None = wall clock + offset). The
+# discrete-event simulator installs one so scenario time is EXACTLY the
+# engine's virtual time: with only an offset, real seconds spent executing
+# Python between fake-clock advances would leak into get_dht_time() and make
+# two same-seed runs diverge wherever a deadline comparison is close.
+_dht_time_source = None
 
 
 def get_dht_time() -> DHTExpiration:
     """Wall-clock time shared across the collaboration.
 
     Peers are assumed NTP-synchronized (same assumption as the reference
-    stack); ``set_dht_time_offset`` exists for tests that need a fake clock.
+    stack); ``set_dht_time_offset`` exists for tests that need a fake clock,
+    and ``set_dht_time_source`` for the simulator's fully-virtual clock.
     """
+    if _dht_time_source is not None:
+        return _dht_time_source()
     return time.time() + _dht_time_offset
 
 
 def set_dht_time_offset(offset: float) -> None:
     global _dht_time_offset
     _dht_time_offset = offset
+
+
+def set_dht_time_source(source) -> None:
+    """Install (or with None, remove) a zero-argument callable that REPLACES
+    the wall clock entirely. Scenario code under the simulator engine sees a
+    bit-reproducible timeline regardless of how long the host takes to
+    execute it."""
+    global _dht_time_source
+    _dht_time_source = source
 
 
 T = TypeVar("T")

@@ -57,6 +57,10 @@ class DHTNode:
         replication_interval: float = 600.0,  # Kademlia-style, much slower
         # than eviction/refresh: a full lookup+store fan-out per held record
         # every 30s would be orders of magnitude more traffic than needed
+        transport=None,  # dht/transport.py seam: None = real TCP; the
+        # simulator passes its in-process network so 1000 nodes share a loop
+        telemetry_registry=None,  # per-peer scope for in-process multi-peer
+        # runs (telemetry/registry.py); None falls back to the global
     ) -> "DHTNode":
         self = object.__new__(cls)
         self.node_id = node_id or DHTID.generate()
@@ -76,13 +80,18 @@ class DHTNode:
         self.storage = DHTLocalStorage()
         self.cache = DHTLocalStorage(maxsize=2000)
         self.validator = CompositeValidator(record_validators)
-        self.client = RPCClient(request_timeout=request_timeout)
+        self.client = RPCClient(
+            request_timeout=request_timeout, transport=transport,
+            telemetry_registry=telemetry_registry,
+        )
         self.server: Optional[RPCServer] = None
         self.port: Optional[int] = None
         self.advertised_host = advertised_host or "127.0.0.1"
         self._maintenance_task: Optional[asyncio.Task] = None
         if not client_mode:
-            self.server = RPCServer(listen_host, listen_port)
+            self.server = RPCServer(listen_host, listen_port,
+                                    transport=transport,
+                                    telemetry_registry=telemetry_registry)
             for method in ("dht.ping", "dht.find", "dht.store"):
                 self.server.register(method, getattr(self, "_rpc_" + method.split(".")[1]))
             await self.server.start()

@@ -27,6 +27,7 @@ import time
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 from dedloc_tpu.core.serialization import pack_obj, unpack_obj
+from dedloc_tpu.dht import transport as transport_mod
 from dedloc_tpu.telemetry import registry as telemetry
 from dedloc_tpu.testing import faults
 from dedloc_tpu.utils.logging import get_logger
@@ -126,13 +127,17 @@ class RPCServer:
     connection (pipelined)."""
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
-                 telemetry_registry=None):
+                 telemetry_registry=None, transport=None):
         self.host, self.requested_port = host, port
         # per-peer scope for in-process multi-peer tests; None falls back to
         # the process-global registry (production: one peer per process)
         self.telemetry = telemetry_registry
+        # the transport seam (dht/transport.py): None = real asyncio TCP,
+        # exactly the pre-seam wire; the simulator injects its in-process
+        # network here and everything above this line runs unmodified
+        self.transport = transport_mod.resolve(transport)
         self._handlers: Dict[str, Handler] = {}
-        self._server: Optional[asyncio.AbstractServer] = None
+        self._server: Optional[transport_mod.Listener] = None
         self._writers: set = set()
         self.port: Optional[int] = None
         # server-initiated calls piped DOWN an inbound connection (circuit
@@ -196,10 +201,10 @@ class RPCServer:
             fut.set_result(msg)
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(
-            self._on_connection, self.host, self.requested_port
+        self._server = await self.transport.start_server(
+            self.host, self.requested_port, self._on_connection
         )
-        self.port = self._server.sockets[0].getsockname()[1]
+        self.port = self._server.port
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -283,18 +288,24 @@ class RPCServer:
         try:
             write_frame(writer, reply)
             await writer.drain()
-        except (ConnectionResetError, RuntimeError, BrokenPipeError):
+        except (OSError, RuntimeError):
+            # best-effort reply: any transport-level failure (reset, broken
+            # pipe, a simulated-link 'error' fault from drain) means the
+            # caller is unreachable — drop the reply, never kill the task
             pass
 
 
 class RPCClient:
     """Pooled msgpack-RPC client: one persistent connection per endpoint."""
 
-    def __init__(self, request_timeout: float = 5.0, telemetry_registry=None):
+    def __init__(self, request_timeout: float = 5.0, telemetry_registry=None,
+                 transport=None):
         self.request_timeout = request_timeout
         # per-peer scope for in-process multi-peer tests; None falls back to
         # the process-global registry (production: one peer per process)
         self.telemetry = telemetry_registry
+        # the transport seam (dht/transport.py): None = real asyncio TCP
+        self.transport = transport_mod.resolve(transport)
         self._conns: Dict[Endpoint, Tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
         self._pending: Dict[Endpoint, Dict[int, asyncio.Future]] = {}
         self._readers: Dict[Endpoint, asyncio.Task] = {}
@@ -313,9 +324,13 @@ class RPCClient:
         async with lock:
             if endpoint in self._conns:
                 return self._conns[endpoint]
-            t0 = time.perf_counter()
-            reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(*endpoint), timeout=self.request_timeout
+            # monotonic_clock, not perf_counter: it also advances with the
+            # fake-clock offset, so under the simulator engine the sampled
+            # RTT reflects the MODELED link latency (production offset is 0
+            # — identical to a raw monotonic read there)
+            t0 = telemetry.monotonic_clock()
+            reader, writer = await self.transport.open_connection(
+                endpoint, timeout=self.request_timeout
             )
             tele = telemetry.resolve(self.telemetry)
             if tele is not None:
@@ -323,7 +338,7 @@ class RPCClient:
                 # per-link RTT estimate's "piggybacked ping" (one sample per
                 # pooled connection, zero traffic added to the hot path)
                 tele.links().observe_rtt(
-                    endpoint, time.perf_counter() - t0
+                    endpoint, max(0.0, telemetry.monotonic_clock() - t0)
                 )
             _set_nodelay(writer)
             self._conns[endpoint] = (reader, writer)
@@ -367,7 +382,10 @@ class RPCClient:
         try:
             write_frame(conn[1], reply)
             await conn[1].drain()
-        except (ConnectionResetError, RuntimeError, BrokenPipeError):
+        except (OSError, RuntimeError):
+            # best-effort reply: any transport-level failure (reset, broken
+            # pipe, a simulated-link 'error' fault from drain) means the
+            # caller is unreachable — drop the reply, never kill the task
             pass
 
     async def register_with_relay(

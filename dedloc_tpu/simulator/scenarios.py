@@ -1,0 +1,404 @@
+"""Named simulator scenarios + the sizing report.
+
+A scenario is a JSON-able spec dict run to a REPORT dict — the numbers an
+operator (or a test) needs before renting a fleet: how wide DHT records
+actually replicate at N peers, how contended matchmaking leadership gets at
+J concurrent joiners, how round-formation latency distributes, how big the
+checkpoint catalog record grows per announcer. ``tools/swarm_sim.py`` is
+the CLI face; ``tests/test_simulator.py`` asserts the report numbers
+against scenario-level bounds.
+
+Spec schema (docs/simulator.md):
+
+    {
+      "scenario": "mixed",          # dht_churn | matchmaking | catalog | mixed
+      "seed": 0,                     # engine + network + churn seed
+      "peers": 1000,                 # swarm size
+      "link": {"latency_s": 0.02, "bandwidth_bps": 12500000.0,
+               "loss": 0.0, "jitter_s": 0.0},
+      "bucket_size": 8, "num_replicas": 5, "parallel_rpc": 3,
+      ...scenario-specific keys (each runner documents its own)
+    }
+
+Every runner is deterministic for a fixed spec: scenario randomness comes
+from ``random.Random(seed)``, peer ids/bootstrap choices hash off the same
+seed, and the engine freezes scenario time.
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from dedloc_tpu.core.timeutils import get_dht_time
+from dedloc_tpu.simulator.engine import SIM_EPOCH, SimEngine
+from dedloc_tpu.simulator.network import LinkSpec, SimNetwork
+from dedloc_tpu.simulator.swarm import SimSwarm
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile (deterministic, no interpolation surprises
+    across numpy versions); 0.0 on empty input."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = min(len(ordered) - 1, max(0, int(round(q * (len(ordered) - 1)))))
+    return ordered[rank]
+
+
+def _span_durations(swarm: SimSwarm, name: str,
+                    ok_only: bool = True) -> List[float]:
+    out = []
+    for peer in swarm.peers:
+        for record in peer.telemetry.events:
+            if record.get("event") != name:
+                continue
+            if ok_only and record.get("ok") is not True:
+                continue
+            out.append(float(record.get("dur_s", 0.0)))
+    return out
+
+
+def record_fanout(swarm: SimSwarm, key: bytes) -> int:
+    """How many live peers hold ``key`` in primary storage — the measured
+    replica fan-out a sizing decision needs vs the configured
+    ``num_replicas`` bound."""
+    count = 0
+    for peer in swarm.alive_peers():
+        if peer.node.storage.get(key) is not None:
+            count += 1
+    return count
+
+
+# --------------------------------------------------------------- harness
+
+
+class ScenarioRun:
+    """Everything a scenario phase needs in one handle."""
+
+    def __init__(self, spec: Dict[str, Any]):
+        self.spec = dict(spec)
+        self.seed = int(spec.get("seed", 0))
+        self.rng = random.Random(self.seed ^ 0xC0FFEE)
+        self.engine = SimEngine(seed=self.seed)
+        self.network = SimNetwork(
+            seed=self.seed, default_link=LinkSpec.from_dict(spec.get("link"))
+        )
+        self.swarm = SimSwarm(
+            self.network,
+            seed=self.seed,
+            bucket_size=int(spec.get("bucket_size", 8)),
+            num_replicas=int(spec.get("num_replicas", 5)),
+            parallel_rpc=int(spec.get("parallel_rpc", 3)),
+            request_timeout=float(spec.get("request_timeout", 5.0)),
+        )
+        self.report: Dict[str, Any] = {
+            "scenario": spec.get("scenario"),
+            "seed": self.seed,
+            "peers": int(spec.get("peers", 100)),
+        }
+
+
+# --------------------------------------------------------------- phases
+#
+# Phases are composable coroutine builders: each takes (run, spec) and
+# fills a section of run.report. The mixed scenario chains them over ONE
+# swarm — churn from the DHT phase is still in effect when matchmaking
+# starts, which is the point.
+
+
+async def phase_spawn(run: ScenarioRun) -> None:
+    n = int(run.spec.get("peers", 100))
+    t0 = time.perf_counter()
+    v0 = run.engine.clock.offset
+    await run.swarm.spawn(n, bootstrap_fanout=int(
+        run.spec.get("bootstrap_fanout", 2)
+    ))
+    run.report["spawn"] = {
+        "peers": n,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "virtual_s": round(run.engine.clock.offset - v0, 3),
+    }
+
+
+async def phase_dht(run: ScenarioRun) -> None:
+    """Puts from scattered writers, churn a fraction of the swarm, then
+    reads — measuring replica fan-out vs the ``num_replicas`` bound and
+    get success under churn."""
+    spec = run.spec
+    puts = int(spec.get("puts", 40))
+    churn_fraction = float(spec.get("churn_fraction", 0.2))
+    swarm, rng = run.swarm, run.rng
+    keys = [f"sim-record-{i:03d}".encode() for i in range(puts)]
+    now = get_dht_time()
+    stored = 0
+    for i, key in enumerate(keys):
+        writer = swarm.alive_peers()[
+            rng.randrange(len(swarm.alive_peers()))
+        ]
+        if await writer.node.store(key, b"v-%d" % i, now + 3600.0):
+            stored += 1
+    fanout = [record_fanout(swarm, key) for key in keys]
+    # churn: kill a seeded sample, all at once (mass-disconnect shape)
+    victims = rng.sample(
+        swarm.alive_peers(), int(len(swarm.alive_peers()) * churn_fraction)
+    )
+    for victim in victims:
+        await swarm.kill(victim)
+    await asyncio.sleep(1.0)  # virtual settling time
+    hits = 0
+    for i, key in enumerate(keys):
+        reader = swarm.alive_peers()[
+            rng.randrange(len(swarm.alive_peers()))
+        ]
+        entry = await reader.node.get(key, latest=True)
+        if entry is not None and entry.value == b"v-%d" % i:
+            hits += 1
+    run.report["dht"] = {
+        "puts": puts,
+        "stored": stored,
+        "replica_bound": swarm.num_replicas + 1,  # nearest set + self-store
+        "fanout_mean": round(sum(fanout) / max(1, len(fanout)), 2),
+        "fanout_max": max(fanout) if fanout else 0,
+        "churned": len(victims),
+        "get_hits": hits,
+        "get_success": round(hits / max(1, puts), 3),
+    }
+
+
+async def phase_matchmaking(run: ScenarioRun) -> None:
+    """R rounds of J concurrent joiners targeting ``group_size`` — the
+    leader-contention measurement: do groups form without livelock, how
+    many leaders fight per round, and the round-formation latency
+    distribution."""
+    spec = run.spec
+    joiners = int(spec.get("joiners", 50))
+    rounds = int(spec.get("rounds", 5))
+    group_size = int(spec.get("group_size", 16))
+    window = float(spec.get("window_s", 5.0))
+    prefix = str(spec.get("prefix", "simexp"))
+    swarm, rng = run.swarm, run.rng
+    pool = [p for p in swarm.alive_peers()]
+    participants = (
+        pool if joiners >= len(pool) else rng.sample(pool, joiners)
+    )
+    for peer in participants:
+        if peer.matchmaking is None:
+            peer.attach_matchmaking(
+                prefix, bandwidth=50.0 + (peer.index % 7) * 25.0,
+                target_group_size=group_size,
+                averaging_expiration=window,
+            )
+    formed: Dict[str, List[int]] = {}
+    failures = 0
+    for r in range(rounds):
+        round_id = f"round-{r:04d}"
+        active = [p for p in participants if p.alive]
+
+        async def one(peer):
+            try:
+                return await peer.matchmaking.form_group(round_id)
+            except Exception:  # noqa: BLE001 — counted, scenario continues
+                return None
+
+        groups = await asyncio.gather(*(one(p) for p in active))
+        sizes = []
+        seen_nonces = set()
+        for g in groups:
+            if g is None:
+                failures += 1
+            elif g.nonce not in seen_nonces:
+                seen_nonces.add(g.nonce)
+                sizes.append(len(g.members))
+        formed[round_id] = sizes
+        # advance past the leader-entry expirations so rounds stay disjoint
+        await asyncio.sleep(window + 1.0)
+    durs = _span_durations(swarm, "mm.form_group")
+    all_sizes = [s for sizes in formed.values() for s in sizes]
+    run.report["matchmaking"] = {
+        "joiners": len(participants),
+        "rounds": rounds,
+        "groups_formed": len(all_sizes),
+        "mean_group_size": round(
+            sum(all_sizes) / max(1, len(all_sizes)), 2
+        ),
+        "full_groups": sum(1 for s in all_sizes if s >= group_size),
+        "singletons": sum(1 for s in all_sizes if s == 1),
+        "join_failures": int(swarm.counters_total("mm.join_failures")),
+        "leader_changes": int(swarm.counters_total("mm.leader_changes")),
+        "form_failures": failures,
+        "formation_p50_s": round(percentile(durs, 0.50), 3),
+        "formation_p95_s": round(percentile(durs, 0.95), 3),
+    }
+
+
+async def phase_catalog(run: ScenarioRun) -> None:
+    """Announcers publish (some divergent) checkpoint manifests; a restorer
+    must select the majority digest and complete a sharded multi-provider
+    restore over the simulated links."""
+    spec = run.spec
+    announcers = int(spec.get("announcers", 8))
+    divergent = int(spec.get("divergent", 2))
+    step = int(spec.get("ckpt_step", 100))
+    total_size = int(spec.get("ckpt_total_size", 4096))
+    shard_size = int(spec.get("ckpt_shard_size", 512))
+    prefix = str(spec.get("prefix", "simexp"))
+    swarm, rng = run.swarm, run.rng
+    alive = swarm.alive_peers()
+    if len(alive) < 2:
+        raise ValueError(
+            f"catalog phase needs >= 2 live peers (an announcer and a "
+            f"restorer); {len(alive)} alive — raise 'peers' or lower churn"
+        )
+    # clamp: at least one non-provider must remain to play the restorer
+    # (reachable from the CLI with e.g. peers=8, announcers=8)
+    announcers = min(announcers, len(alive) - 1)
+    providers = rng.sample(alive, announcers)
+    majority_digest = None
+    for i, peer in enumerate(providers):
+        variant = 1 if i < divergent else 0  # minority forks first
+        digest = peer.serve_checkpoint(
+            step, total_size=total_size, shard_size=shard_size,
+            variant=variant,
+        )
+        if variant == 0:
+            majority_digest = digest
+        ok = await peer.announce_checkpoint(prefix)
+        if not ok:
+            logger.warning(f"catalog announce failed for {peer.label}")
+    from dedloc_tpu.checkpointing.catalog import (
+        catalog_key,
+        parse_announcements,
+        select_target,
+    )
+    from dedloc_tpu.checkpointing.fetcher import sharded_restore
+
+    reader = rng.choice(
+        [p for p in swarm.alive_peers() if p not in providers]
+    )
+    entry = await reader.node.get(catalog_key(prefix).encode(), latest=True)
+    items = (
+        [(sk, v.value) for sk, v in entry.value.items()]
+        if entry is not None and hasattr(entry.value, "items")
+        else []
+    )
+    announcements = parse_announcements(items)
+    # sizing: the ACTUAL stored/wire size — the same msgpack codec the DHT
+    # store path uses, not a Python repr approximation
+    from dedloc_tpu.core.serialization import pack_obj
+
+    catalog_bytes = sum(
+        len(pack_obj(a.model_dump())) for a in announcements
+    )
+    target = select_target(announcements)
+    restored_ok = False
+    providers_used = 0
+    if target is not None:
+        stats: Dict[str, Any] = {}
+        try:
+            _meta, tree, manifest = await sharded_restore(
+                reader.node.client,
+                announcements,
+                parallelism=int(spec.get("fetch_parallelism", 4)),
+                telemetry_registry=reader.telemetry,
+                stats=stats,
+            )
+            restored_ok = (
+                manifest.digest() == majority_digest
+                and "sim_state" in tree
+            )
+            providers_used = int(stats.get("providers", 0))
+        except Exception as e:  # noqa: BLE001 — reported, not raised
+            logger.warning(f"sim restore failed: {e!r}")
+    run.report["catalog"] = {
+        "announcers": announcers,
+        "divergent": divergent,
+        "parsed_announcements": len(announcements),
+        "selected_majority": bool(
+            target is not None and target[1] == majority_digest
+        ),
+        "restore_ok": restored_ok,
+        "providers_used": providers_used,
+        "catalog_record_bytes": catalog_bytes,
+        "bytes_per_announcer": (
+            round(catalog_bytes / max(1, len(announcements)))
+        ),
+    }
+
+
+# -------------------------------------------------------------- scenarios
+
+
+async def _scenario_dht_churn(run: ScenarioRun) -> None:
+    await phase_spawn(run)
+    await phase_dht(run)
+
+
+async def _scenario_matchmaking(run: ScenarioRun) -> None:
+    await phase_spawn(run)
+    await phase_matchmaking(run)
+
+
+async def _scenario_catalog(run: ScenarioRun) -> None:
+    await phase_spawn(run)
+    await phase_catalog(run)
+
+
+async def _scenario_mixed(run: ScenarioRun) -> None:
+    """The acceptance scenario: DHT churn + matchmaking rounds + catalog
+    announcements over ONE swarm, in that order, so each phase inherits
+    the previous one's damage."""
+    await phase_spawn(run)
+    await phase_dht(run)
+    await phase_matchmaking(run)
+    await phase_catalog(run)
+
+
+SCENARIOS: Dict[str, Callable] = {
+    "dht_churn": _scenario_dht_churn,
+    "matchmaking": _scenario_matchmaking,
+    "catalog": _scenario_catalog,
+    "mixed": _scenario_mixed,
+}
+
+
+def run_scenario(
+    spec: Dict[str, Any], out_dir: Optional[str] = None
+) -> Dict[str, Any]:
+    """Run one scenario spec to its sizing report (wall-clock bounded only
+    by the Python it executes — scenario time is fake). When ``out_dir``
+    is given, per-peer telemetry JSONL lands there for ``runlog_summary``.
+    """
+    name = str(spec.get("scenario", "mixed"))
+    if name not in SCENARIOS:
+        raise ValueError(
+            f"unknown scenario {name!r}; pick one of {sorted(SCENARIOS)}"
+        )
+    run = ScenarioRun(spec)
+    t0 = time.perf_counter()
+    try:
+        with run.engine:
+            run.engine.run(
+                SCENARIOS[name](run),
+                timeout=float(spec.get("virtual_timeout_s", 36000.0)),
+            )
+            run.engine.run(run.swarm.shutdown())
+            run.report["virtual_s"] = round(
+                run.engine.clock.offset - SIM_EPOCH, 3
+            )
+            run.report["wall_s"] = round(time.perf_counter() - t0, 3)
+            run.report["net"] = {
+                "total_bytes": sum(run.network.stats["bytes"].values()),
+                "total_flushes": sum(run.network.stats["flushes"].values()),
+                "resets": run.network.stats["resets"],
+                "loss_drops": run.network.stats["loss_drops"],
+            }
+            if out_dir is not None:
+                run.report["event_logs"] = run.swarm.dump_event_logs(out_dir)
+    finally:
+        run.engine.close()
+    return run.report

@@ -1,0 +1,329 @@
+"""Spawn N full peers — DHT node, matchmaker, optional checkpoint-catalog
+announcer — in ONE process on the simulated transport.
+
+Each peer gets:
+
+- its own simulated host (``peer-0042``) and ``SimTransport`` bound to it,
+  so the network can charge its serialized uplink and stamp peernames;
+- a deterministic node id derived from (swarm seed, peer index) — two
+  same-seed runs build the identical Kademlia topology;
+- a component-scoped ``Telemetry`` registry (the PR 2 machinery for
+  in-process multi-peer attribution), held in memory and dumped to
+  per-peer JSONL by ``dump_event_logs`` so ``runlog_summary
+  --health/--trace/--topology`` work on simulator output unchanged.
+
+Everything the peer runs — ``DHTNode`` iterative lookups, ``Matchmaking``
+leader election, ``checkpointing.fetcher`` restores — is the PRODUCTION
+code, untouched, running above the transport seam.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from dedloc_tpu.averaging.matchmaking import Matchmaking
+from dedloc_tpu.checkpointing.catalog import (
+    CheckpointAnnouncement,
+    catalog_key,
+)
+from dedloc_tpu.checkpointing.manifest import CheckpointManifest, shard_bytes
+from dedloc_tpu.core.serialization import CompressionType, serialize_array
+from dedloc_tpu.core.timeutils import get_dht_time
+from dedloc_tpu.dht.node import DHTNode
+from dedloc_tpu.dht.routing import DHTID
+from dedloc_tpu.simulator.network import SimNetwork
+from dedloc_tpu.telemetry.registry import Telemetry
+from dedloc_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _synthetic_checkpoint(
+    step: int, total_size: int, shard_size: int, variant: int = 0
+):
+    """A tiny deterministic (manifest, flat) pair for catalog scenarios:
+    real manifests, real digests, real shard bytes — no model needed.
+    ``variant`` perturbs the content, producing a DIVERGENT manifest at the
+    same step (the catalog's majority-digest selection must reject it)."""
+    flat = (
+        np.arange(total_size, dtype=np.float32) + np.float32(variant * 1000)
+    )
+    digests = []
+    for start in range(0, total_size, shard_size):
+        chunk = np.ascontiguousarray(flat[start : start + shard_size])
+        digests.append(hashlib.sha256(chunk.tobytes()).digest())
+    manifest = CheckpointManifest(
+        step=int(step),
+        shard_size=int(shard_size),
+        total_size=int(total_size),
+        spec=(("sim_state", (total_size,), "<f4"),),
+        shard_digests=tuple(digests),
+        metadata={"sim": True, "variant": int(variant)},
+    )
+    return manifest, flat
+
+
+class SimPeer:
+    """One simulated peer. Built by ``SimSwarm.spawn`` — use the swarm."""
+
+    # in-memory event bound per simulated peer: the scenario's telemetry is
+    # read from MEMORY after the run (no per-peer JSONL sink while 1,000
+    # peers share one process), so a busy leader must not evict its early
+    # rounds before the dump
+    MAX_EVENTS = 32768
+
+    def __init__(self, index: int, label: str, host: str):
+        self.index = index
+        self.label = label
+        self.host = host
+        self.telemetry = Telemetry(peer=label, max_events=self.MAX_EVENTS)
+        self.node: Optional[DHTNode] = None
+        self.matchmaking: Optional[Matchmaking] = None
+        self.alive = False
+        # catalog-provider state (when announcing): (manifest, flat)
+        self._checkpoint = None
+
+    @property
+    def endpoint(self):
+        return self.node.endpoint if self.node is not None else None
+
+    # ------------------------------------------------------------ averaging
+
+    def attach_matchmaking(self, prefix: str, bandwidth: float = 100.0,
+                           target_group_size: int = 16,
+                           averaging_expiration: float = 5.0) -> Matchmaking:
+        """Attach the production matchmaker on the peer's existing RPC
+        server/client (the averager's group-formation surface — the part of
+        averaging that has to scale with the swarm)."""
+        self.matchmaking = Matchmaking(
+            node=self.node,
+            client=self.node.client,
+            server=self.node.server,
+            prefix=prefix,
+            peer_id=self.node.node_id.to_bytes(),
+            endpoint=self.endpoint,
+            bandwidth=bandwidth,
+            target_group_size=target_group_size,
+            averaging_expiration=averaging_expiration,
+            telemetry_registry=self.telemetry,
+        )
+        return self.matchmaking
+
+    # ---------------------------------------------------------- checkpoints
+
+    def serve_checkpoint(self, step: int, total_size: int = 4096,
+                         shard_size: int = 1024, variant: int = 0) -> bytes:
+        """Become a checkpoint provider: serve ``ckpt.manifest`` /
+        ``ckpt.shard`` (the averager's wire contract, byte-compatible with
+        ``checkpointing/fetcher.py``) for a synthetic checkpoint. Returns
+        the manifest digest for the announcement."""
+        manifest, flat = _synthetic_checkpoint(
+            step, total_size, shard_size, variant
+        )
+        self._checkpoint = (manifest, flat)
+
+        async def _manifest(_peer, _args):
+            return {"manifest": manifest.to_bytes()}
+
+        async def _shard(_peer, args):
+            index = int(args["index"])
+            raw = shard_bytes(flat, manifest, index)
+            return {
+                "index": index,
+                "data": serialize_array(
+                    np.frombuffer(raw, dtype=np.float32),
+                    CompressionType.NONE,
+                ),
+            }
+
+        self.node.server.register("ckpt.manifest", _manifest)
+        self.node.server.register("ckpt.shard", _shard)
+        return manifest.digest()
+
+    async def announce_checkpoint(self, prefix: str,
+                                  expiration: float = 120.0) -> bool:
+        """Publish this provider's catalog record (schema-checked by any
+        validating node, same as production announcements)."""
+        manifest, _flat = self._checkpoint
+        ann = CheckpointAnnouncement(
+            step=manifest.step,
+            manifest_digest=manifest.digest(),
+            num_shards=manifest.num_shards,
+            endpoint=list(self.endpoint),
+            shards=None,
+        )
+        return await self.node.store(
+            catalog_key(prefix).encode(),
+            ann.model_dump(),
+            get_dht_time() + expiration,
+            subkey=self.label.encode(),
+        )
+
+
+class SimSwarm:
+    """A population of SimPeers over one SimNetwork. All coroutines must run
+    inside the simulator engine (or any asyncio loop — then in real time).
+
+    ``bucket_size``/``num_replicas``/``parallel_rpc`` default smaller than
+    production: a 1,000-node scenario's wall cost is dominated by lookup
+    fan-out, and the sizing report measures how these knobs trade off.
+    """
+
+    def __init__(
+        self,
+        network: SimNetwork,
+        seed: int = 0,
+        bucket_size: int = 8,
+        num_replicas: int = 5,
+        parallel_rpc: int = 3,
+        request_timeout: float = 5.0,
+        record_validators=(),
+    ):
+        self.network = network
+        self.seed = int(seed)
+        self.bucket_size = bucket_size
+        self.num_replicas = num_replicas
+        self.parallel_rpc = parallel_rpc
+        self.request_timeout = request_timeout
+        self.record_validators = record_validators
+        self.peers: List[SimPeer] = []
+
+    # -------------------------------------------------------------- spawn
+
+    def _node_id(self, index: int) -> DHTID:
+        # deterministic ids: same seed => same Kademlia topology
+        return DHTID.of_key(f"sim-swarm-{self.seed}-peer-{index}")
+
+    async def spawn(
+        self,
+        n: int,
+        bootstrap_fanout: int = 2,
+        client_mode: bool = False,
+        maintenance_interval: float = 0.0,
+    ) -> List[SimPeer]:
+        """Create ``n`` peers, each bootstrapping off up to
+        ``bootstrap_fanout`` already-live peers (deterministically chosen).
+        Background maintenance defaults OFF — scenarios drive
+        ``run_maintenance`` explicitly so every run replays identically."""
+        created: List[SimPeer] = []
+        for i in range(n):
+            index = len(self.peers)
+            label = f"peer-{index:04d}"
+            peer = SimPeer(index, label, host=label)
+            seeds = self._bootstrap_endpoints(index, bootstrap_fanout)
+            peer.node = await DHTNode.create(
+                listen_host=peer.host,
+                initial_peers=seeds,
+                node_id=self._node_id(index),
+                bucket_size=self.bucket_size,
+                num_replicas=self.num_replicas,
+                parallel_rpc=self.parallel_rpc,
+                request_timeout=self.request_timeout,
+                record_validators=[v() if callable(v) else v
+                                   for v in self.record_validators],
+                client_mode=client_mode,
+                advertised_host=peer.host,
+                maintenance_interval=maintenance_interval,
+                transport=self.network.transport(peer.host),
+                telemetry_registry=peer.telemetry,
+            )
+            peer.alive = True
+            self.peers.append(peer)
+            created.append(peer)
+        return created
+
+    def _bootstrap_endpoints(self, index: int, fanout: int) -> List:
+        alive = [p for p in self.peers if p.alive and p.endpoint is not None]
+        if not alive or fanout <= 0:
+            return []
+        # deterministic spread WITHOUT consuming shared RNG state: stride
+        # through the live set by a hash of the joiner's index
+        picks = []
+        h = int.from_bytes(
+            hashlib.sha256(f"{self.seed}:{index}".encode()).digest()[:8],
+            "big",
+        )
+        for k in range(min(fanout, len(alive))):
+            picks.append(alive[(h + k * 7919) % len(alive)].endpoint)
+        return list(dict.fromkeys(picks))
+
+    # -------------------------------------------------------------- churn
+
+    async def kill(self, peer: SimPeer) -> None:
+        """Process-death: sockets reset, listeners vanish, nothing graceful
+        (the FaultSchedule ``drop`` contract, swarm-scale)."""
+        if not peer.alive:
+            return
+        peer.alive = False
+        self.network.kill_host(peer.host)
+        if peer.node is not None:
+            await peer.node.shutdown()
+
+    def alive_peers(self) -> List[SimPeer]:
+        return [p for p in self.peers if p.alive]
+
+    async def shutdown(self) -> None:
+        for peer in self.alive_peers():
+            peer.alive = False
+            self.network.kill_host(peer.host)
+            await peer.node.shutdown()
+
+    # ---------------------------------------------------------- telemetry
+
+    def dump_event_logs(self, out_dir: str) -> List[str]:
+        """Write each peer's in-memory event trace to
+        ``<out_dir>/<label>.jsonl`` — the exact per-peer JSONL schema the
+        observability tools consume (``runlog_summary --health/--trace/
+        --topology``). Sequential open/write/close: a 1,000-peer swarm
+        must not hold 1,000 descriptors."""
+        os.makedirs(out_dir, exist_ok=True)
+        paths = []
+        for peer in self.peers:
+            links = peer.telemetry._links
+            if links is not None:
+                # the link.stats flush production peers do on snapshot /
+                # close — without it ``--topology`` has nothing to read
+                links.emit_events(peer.telemetry)
+                peer.telemetry._links = None  # flush once, even if re-dumped
+            if not peer.telemetry.events:
+                continue
+            if len(peer.telemetry.events) == peer.telemetry.events.maxlen:
+                # full deque = almost certainly evicted its head: the
+                # dumped log is a TAIL, and --trace on early rounds will
+                # report orphans — say so instead of degrading silently
+                logger.warning(
+                    f"{peer.label}: event trace hit its in-memory bound "
+                    f"({peer.telemetry.events.maxlen}); dumped log is "
+                    "truncated at the front (raise SimPeer.MAX_EVENTS)"
+                )
+            path = os.path.join(out_dir, f"{peer.label}.jsonl")
+            with open(path, "w", encoding="utf-8") as f:
+                for record in peer.telemetry.events:
+                    f.write(json.dumps(record) + "\n")
+            paths.append(path)
+        return paths
+
+    def event_sequence(
+        self, drop_keys: Sequence[str] = ("t", "dur_s", "span", "parent"),
+    ) -> List[Dict[str, Any]]:
+        """The swarm's telemetry events, per peer in spawn order, with the
+        wall-dependent / randomly-identified fields stripped — the
+        determinism fingerprint two same-seed runs must agree on."""
+        out: List[Dict[str, Any]] = []
+        for peer in self.peers:
+            for record in peer.telemetry.events:
+                out.append(
+                    {k: v for k, v in record.items() if k not in drop_keys}
+                )
+        return out
+
+    def counters_total(self, name: str) -> float:
+        return sum(
+            p.telemetry.counters[name].value
+            for p in self.peers
+            if name in p.telemetry.counters
+        )

@@ -221,13 +221,17 @@ class Telemetry:
         event_log_path: Optional[str] = None,
         clock: Optional[Callable[[], float]] = None,
         link_top_k: int = 8,
+        max_events: Optional[int] = None,
     ) -> None:
         self.peer = peer
         self.clock = clock or monotonic_clock
         self.counters: Dict[str, Counter] = {}
         self.gauges: Dict[str, Gauge] = {}
         self.histograms: Dict[str, Histogram] = {}
-        self.events: Deque[dict] = deque(maxlen=self.MAX_EVENTS)
+        # ``max_events`` overrides the in-memory bound: consumers that read
+        # events from MEMORY instead of the JSONL sink (the swarm simulator
+        # dumps post-run) need room for a whole scenario per peer
+        self.events: Deque[dict] = deque(maxlen=max_events or self.MAX_EVENTS)
         # per-link network estimator (telemetry/links.py), created on first
         # observation; ``link_top_k`` bounds how many links ride the metrics
         # bus snapshot (the busiest first)

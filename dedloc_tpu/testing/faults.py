@@ -44,7 +44,9 @@ import random
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from dedloc_tpu.core.timeutils import set_dht_time_offset
+import heapq
+
+from dedloc_tpu.core.timeutils import set_dht_time_offset, set_dht_time_source
 
 
 @dataclass
@@ -195,6 +197,18 @@ async def apply_transport_fault(fault: Fault, what: str) -> None:
         raise OSError(f"fault injected: error on {what}")
 
 
+class ClockHandle:
+    """Cancellation handle for a ``FakeClock.wake_at`` sleeper."""
+
+    __slots__ = ("cancelled",)
+
+    def __init__(self) -> None:
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
 class FakeClock:
     """Deterministic scenario clock over ``set_dht_time_offset``.
 
@@ -207,18 +221,112 @@ class FakeClock:
     The offset is process-global (every in-process peer shares the DHT
     clock, as NTP-synchronized real peers would), and restored to zero on
     exit.
+
+    **Sleepers and the seeded tie-break.** ``wake_at(when, callback)``
+    registers a callback fired by ``advance`` when scenario time reaches
+    ``when``. Two sleepers registered for the IDENTICAL fake timestamp used
+    to resolve in heap insertion order — an implementation detail of
+    ``heapq`` that is not promised across Python versions, so simulator
+    runs were not bit-reproducible. The documented ordering rule is now:
+    same-deadline sleepers fire in the order of a per-sleeper draw from the
+    clock's seeded RNG (``seed`` constructor arg), taken at REGISTRATION
+    time. Given the same seed and the same registration sequence, the wake
+    order is a pure function of the schedule on every Python version; a
+    different seed may legally produce a different (but equally
+    deterministic) order. The discrete-event engine
+    (``simulator/engine.py``) draws the same stream via
+    ``tiebreak_epsilon`` for its event-loop timers, so one seed governs
+    every same-timestamp decision in a simulated swarm.
+
+    **Frozen mode.** ``frozen=True`` additionally installs a full
+    ``get_dht_time`` override returning exactly ``start + advanced``: real
+    seconds spent EXECUTING scenario code between advances no longer leak
+    into the timeline (with only an offset they would, because the offset
+    rides on ``time.time()``). The simulator engine uses this; offset-only
+    behavior is unchanged for existing tests.
     """
 
-    def __init__(self, start: float = 0.0):
+    def __init__(self, start: float = 0.0, seed: int = 0,
+                 frozen: bool = False):
         self.offset = float(start)
+        self.frozen = bool(frozen)
+        self.rng = random.Random(seed)
+        # heap rows: (when, tiebreak, seq, callback, handle) — ``tiebreak``
+        # is the seeded draw that defines same-deadline order; ``seq`` only
+        # breaks the astronomically-unlikely equal-draw case
+        self._sleepers: List[tuple] = []
+        self._seq = 0
+
+    # ------------------------------------------------------------- sleepers
+
+    def tiebreak_epsilon(self, scale: float = 1e-6) -> float:
+        """A strictly-positive seeded jitter in ``(0, ~2*scale]`` for the
+        engine's event-loop timer deadlines. Two components, both
+        deterministic functions of the schedule: a seeded draw at ``scale``
+        (dominates — same-deadline ordering follows the seeded stream, not
+        timer-heap internals) plus a strictly-increasing sequence term
+        three orders of magnitude smaller, which keeps two epsilons
+        distinct even when their draws round to the same float (at
+        simulation-epoch magnitudes a float's resolution is ~1e-10 s, so a
+        pure nano-scale draw would quantize to a handful of values and
+        collide — reintroducing heap-order nondeterminism)."""
+        self._seq += 1
+        return (
+            (1.0 - self.rng.random()) * scale
+            + (self._seq % 1000 + 1) * scale * 1e-3
+        )
+
+    def wake_at(self, when: float, callback: Callable[[], Any]) -> ClockHandle:
+        """Register ``callback`` to fire when scenario time reaches
+        ``when`` (fired inside ``advance``, never from real time)."""
+        handle = ClockHandle()
+        heapq.heappush(
+            self._sleepers,
+            (float(when), self.rng.random(), self._seq, callback, handle),
+        )
+        self._seq += 1
+        return handle
+
+    def next_wake(self) -> Optional[float]:
+        """Earliest pending sleeper deadline, or None."""
+        while self._sleepers and self._sleepers[0][4].cancelled:
+            heapq.heappop(self._sleepers)
+        return self._sleepers[0][0] if self._sleepers else None
+
+    def _fire_due(self) -> None:
+        while self._sleepers and self._sleepers[0][0] <= self.offset:
+            when, _tb, _seq, callback, handle = heapq.heappop(self._sleepers)
+            if handle.cancelled:
+                continue
+            callback()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def now(self) -> float:
+        return self.offset
 
     def __enter__(self) -> "FakeClock":
         set_dht_time_offset(self.offset)
+        if self.frozen:
+            set_dht_time_source(self.now)
         return self
 
     def advance(self, seconds: float) -> None:
-        self.offset += float(seconds)
+        self.advance_to(self.offset + float(seconds))
+
+    def advance_to(self, target: float) -> None:
+        """Move scenario time forward to ``target``, firing due sleepers in
+        deadline order (seeded tie-break within one deadline); each sleeper
+        observes the clock AT its own deadline."""
+        target = float(target)
+        while self._sleepers and self._sleepers[0][0] <= target:
+            self.offset = max(self.offset, self._sleepers[0][0])
+            set_dht_time_offset(self.offset)
+            self._fire_due()
+        self.offset = max(self.offset, target)
         set_dht_time_offset(self.offset)
 
     def __exit__(self, *exc) -> None:
         set_dht_time_offset(0.0)
+        if self.frozen:
+            set_dht_time_source(None)

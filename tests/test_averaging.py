@@ -963,6 +963,11 @@ def test_gated_client_mode_peer_joins():
     asyncio.run(run())
 
 
+@pytest.mark.slow  # ~96s of real averaging windows — the #2 tier-1
+# wall-clock offender (tools/t1_budget.py). Its transport-level contract
+# (concurrent groups, churn mid-assembly, rounds keep advancing) now runs
+# tier-1 in seconds on the simulated transport:
+# tests/test_simulator.py::test_sim_port_scale_32_peers_concurrent_groups_with_churn
 def test_scale_32_peers_concurrent_groups_with_churn(rng):
     """VERDICT r1 item 6: ~32 peers with target_group_size=8 form several
     concurrent groups per round while some peers die mid-assembly. Every

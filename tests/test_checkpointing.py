@@ -635,6 +635,30 @@ def test_fault_injected_multi_peer_restore(rng, tmp_path):
             provider.set_shared_state(tree, {"step": 42, "local_step": 42})
             provider.publish_state_provider(expiration=60.0)
 
+        # deflake: catalog announcements are published fire-and-forget, so
+        # the joiner can race a half-propagated catalog, see provider A as
+        # the ONLY announcer, and (correctly) fail over to the blob path
+        # when A dies — wait until the joiner's own DHT view holds BOTH
+        # announcements before starting the restore under faults
+        import time as _time
+
+        from dedloc_tpu.checkpointing.catalog import catalog_key
+
+        deadline = _time.time() + 15.0
+        while _time.time() < deadline:
+            entry = dhts[2].get(catalog_key("accept"), latest=True)
+            if (
+                entry is not None
+                and hasattr(entry.value, "items")
+                and len(list(entry.value.items())) >= 2
+            ):
+                break
+            _time.sleep(0.05)
+        else:
+            raise AssertionError(
+                "catalog never showed both providers to the joiner"
+            )
+
         served_a = {"n": 0}
 
         def a_dies_mid_fetch(ctx):

@@ -407,6 +407,11 @@ def test_evaluate_role_reports_holdout_loss(tmp_path):
     assert again["mlm_loss"] == result["mlm_loss"]  # deterministic
 
 
+@pytest.mark.slow  # ~109s of real trainer rounds — the #1 tier-1
+# wall-clock offender (tools/t1_budget.py). The transport-level contract
+# (client-mode peer collaborates through a circuit relay, real group of 2)
+# now runs tier-1 in seconds on the simulated transport:
+# tests/test_simulator.py::test_sim_port_client_mode_peers_collaborate_via_relay
 def test_client_mode_trainer_collaborates_via_relay(tmp_path):
     """A firewalled trainer (--dht.client_mode + --dht.relay) leads/joins
     rounds through a public peer's circuit relay — the full role stack with

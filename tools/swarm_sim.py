@@ -1,0 +1,164 @@
+"""Run named swarm-simulator scenarios and emit a sizing report.
+
+The discrete-event simulator (dedloc_tpu/simulator, docs/simulator.md) runs
+1,000+ full peers — DHT nodes, matchmakers, checkpoint-catalog announcers —
+in ONE process at fake-clock speed behind the simulated transport. This CLI
+is the operator face: pick a scenario, get the numbers that size a real
+fleet (record fan-out vs N, matchmaking leader contention, round-formation
+latency percentiles, catalog growth) before renting it.
+
+Usage::
+
+    python tools/swarm_sim.py --list
+    python tools/swarm_sim.py --scenario mixed --peers 1000 --seed 0
+    python tools/swarm_sim.py --spec my_scenario.json --out /tmp/sim
+    python tools/swarm_sim.py --scenario matchmaking --set joiners=200 \
+        --set window_s=2.0
+
+``--out DIR`` additionally dumps per-peer telemetry JSONL there — the same
+event-log schema production peers write, so the observability tools work on
+simulator output unchanged::
+
+    python tools/runlog_summary.py --health  /tmp/sim/*.jsonl
+    python tools/runlog_summary.py --trace round-0000 /tmp/sim/*.jsonl
+    python tools/runlog_summary.py --topology /tmp/sim/*.jsonl
+
+Only stdlib + the in-repo simulator; exits nonzero if the scenario raises.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# runnable as `python tools/swarm_sim.py` from anywhere, without install
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _coerce(value: str):
+    for cast in (int, float):
+        try:
+            return cast(value)
+        except ValueError:
+            continue
+    if value.lower() in ("true", "false"):
+        return value.lower() == "true"
+    return value
+
+
+def _human(report: dict) -> str:
+    out = [
+        f"scenario {report.get('scenario')} · seed {report.get('seed')} · "
+        f"{report.get('peers')} peers",
+        f"wall {report.get('wall_s', '?')}s · "
+        f"virtual {report.get('virtual_s', '?')}s",
+    ]
+    spawn = report.get("spawn")
+    if spawn:
+        out.append(
+            f"spawn: {spawn['peers']} peers in {spawn['wall_s']}s wall "
+            f"({spawn['virtual_s']}s virtual)"
+        )
+    dht = report.get("dht")
+    if dht:
+        out.append(
+            f"dht: fan-out mean {dht['fanout_mean']} / max "
+            f"{dht['fanout_max']} (bound {dht['replica_bound']}), "
+            f"gets {dht['get_hits']}/{dht['puts']} after "
+            f"{dht['churned']} peer kills"
+        )
+    mm = report.get("matchmaking")
+    if mm:
+        out.append(
+            f"matchmaking: {mm['groups_formed']} groups over "
+            f"{mm['rounds']} round(s) x {mm['joiners']} joiners — mean size "
+            f"{mm['mean_group_size']}, {mm['full_groups']} full, "
+            f"{mm['singletons']} singleton(s); formation p50 "
+            f"{mm['formation_p50_s']}s p95 {mm['formation_p95_s']}s; "
+            f"{mm['join_failures']} join failures, "
+            f"{mm['leader_changes']} leader changes"
+        )
+    cat = report.get("catalog")
+    if cat:
+        out.append(
+            f"catalog: {cat['parsed_announcements']} announcements "
+            f"({cat['divergent']} divergent), majority selected: "
+            f"{cat['selected_majority']}, restore ok: {cat['restore_ok']} "
+            f"({cat['providers_used']} providers), record "
+            f"{cat['catalog_record_bytes']}B "
+            f"(~{cat['bytes_per_announcer']}B/announcer)"
+        )
+    net = report.get("net")
+    if net:
+        out.append(
+            f"wire: {net['total_bytes']} bytes / {net['total_flushes']} "
+            f"flushes, {net['resets']} resets, "
+            f"{net['loss_drops']} loss-kills"
+        )
+    logs = report.get("event_logs")
+    if logs:
+        out.append(f"event logs: {len(logs)} peers -> "
+                   f"{logs[0].rsplit('/', 1)[0]}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> None:
+    # heavyweight imports after arg parsing so --list/--help stay instant
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+    )
+    parser.add_argument("--scenario", default=None,
+                        help="named scenario (see --list)")
+    parser.add_argument("--spec", default=None,
+                        help="JSON spec file (overrides --scenario fields)")
+    parser.add_argument("--list", action="store_true",
+                        help="list scenario names and exit")
+    parser.add_argument("--peers", type=int, default=None)
+    parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--out", default=None,
+                        help="directory for per-peer telemetry JSONL")
+    parser.add_argument("--set", action="append", default=[],
+                        metavar="KEY=VALUE",
+                        help="override any spec key (repeatable)")
+    parser.add_argument("--json", action="store_true",
+                        help="print the raw report JSON only")
+    args = parser.parse_args(argv)
+
+    from dedloc_tpu.simulator.scenarios import SCENARIOS, run_scenario
+
+    if args.list:
+        for name in sorted(SCENARIOS):
+            print(name)
+        return
+
+    spec = {}
+    if args.spec:
+        with open(args.spec, encoding="utf-8") as f:
+            spec.update(json.load(f))
+    if args.scenario:
+        spec["scenario"] = args.scenario
+    if args.peers is not None:
+        spec["peers"] = args.peers
+    if args.seed is not None:
+        spec["seed"] = args.seed
+    for item in args.set:
+        key, _, value = item.partition("=")
+        if not _:
+            sys.exit(f"--set expects KEY=VALUE, got {item!r}")
+        spec[key] = _coerce(value)
+    if "scenario" not in spec:
+        sys.exit("pick a scenario: --scenario NAME or --spec FILE "
+                 "(--list shows names)")
+
+    report = run_scenario(spec, out_dir=args.out)
+    if args.json:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        print(_human(report))
+        print()
+        print(json.dumps(report, indent=1, default=str))
+
+
+if __name__ == "__main__":
+    main()
