@@ -39,6 +39,8 @@ from dedloc_tpu.collaborative.progress import (
 from dedloc_tpu.core.timeutils import PerformanceEMA, get_dht_time
 from dedloc_tpu.dht.dht import DHT
 from dedloc_tpu.telemetry import registry as telemetry
+from dedloc_tpu.telemetry import steps
+from dedloc_tpu.telemetry.registry import monotonic_clock
 from dedloc_tpu.parallel.train_step import (
     TrainState,
     make_apply_step,
@@ -192,6 +194,15 @@ class CollaborativeOptimizer:
         # keeps its full count published throughout averaging and resets
         # only together with the step advance)
         self._overlap_committed_samples = 0
+        # overlap ledger (docs/observability.md "overlap ledger"): per
+        # boundary, how much of the averaging round's launch→finish wall was
+        # HIDDEN behind concurrent accumulation vs EXPOSED as stall. Clocked
+        # on the FakeClock-aware monotonic clock; only maintained when
+        # overlap_averaging is configured (it measures that feature).
+        self._overlap_launched_at = 0.0
+        self._overlap_resumed_at: Optional[float] = None
+        self._overlap_done_at: Optional[float] = None
+        self._overlap_hidden_s = 0.0
         self.error_feedback = ErrorFeedback(
             compression if error_feedback else "none"
         )
@@ -328,12 +339,27 @@ class CollaborativeOptimizer:
                 self._ema_started = True
 
             if self._overlap_inflight is not None:
+                # overlap ledger: the wall since this peer resumed
+                # accumulating was HIDDEN behind the in-flight round — but
+                # only up to the moment the round actually finished
+                # (accumulation past that point hides nothing)
+                now = monotonic_clock()
+                if self._overlap_resumed_at is not None:
+                    done_at = self._overlap_done_at
+                    covered = (min(now, done_at) if done_at is not None
+                               else now)
+                    self._overlap_hidden_s += max(
+                        0.0, covered - self._overlap_resumed_at
+                    )
+                    self._overlap_resumed_at = None
                 if not self._overlap_inflight["future"].done():
                     # a background round is in flight: keep accumulating —
                     # its result applies one boundary late (the overlap
                     # staleness contract, docs/fleet.md). Catch-up/ramp
                     # decisions wait until the round lands.
-                    self._report(synced=True)
+                    with steps.phase("collab"):
+                        self._report(synced=True)
+                    self._overlap_resumed_at = monotonic_clock()
                     return state, grad_acc, n_acc, False
                 state, grad_acc, n_acc, stepped, applied = (
                     self._harvest_overlap(state, grad_acc, n_acc)
@@ -343,7 +369,8 @@ class CollaborativeOptimizer:
                 # failed overlapped round: its gradients were restored into
                 # the accumulator — fall through to the synchronous path
 
-            collab = self.tracker.fetch_collaboration_state()
+            with steps.phase("collab"):
+                collab = self.tracker.fetch_collaboration_state()
             gap = collab.optimizer_step - self.local_step
             if (
                 gap > self.resync_step_gap
@@ -377,14 +404,16 @@ class CollaborativeOptimizer:
                 # the gradient bias is bounded and weighted by our samples.
                 self.local_step = collab.optimizer_step
 
-            self._report(synced=True)
+            with steps.phase("collab"):
+                self._report(synced=True)
             if not collab.ready_for_step:
                 return state, grad_acc, n_acc, False
 
             # decide the round shape on a FORCED-fresh view: the cached view
             # can lag a just-joined peer, and the solo fast path below must
             # not fire while a partner is mid-round
-            collab = self.tracker.fetch_collaboration_state(force=True)
+            with steps.phase("collab"):
+                collab = self.tracker.fetch_collaboration_state(force=True)
             if collab.optimizer_step > self.local_step:
                 self.local_step = collab.optimizer_step  # raced again: rejoin
             if not collab.ready_for_step:
@@ -577,7 +606,9 @@ class CollaborativeOptimizer:
             )
 
         t0 = time.perf_counter()
-        named = _tree_to_named(mean_grads)  # device_get of the full grad tree
+        with steps.phase("grad_flatten"):
+            # device_get of the full grad tree (the jit↔host seam)
+            named = _tree_to_named(mean_grads)
         self.seam_ms["grads_device_get"] = (time.perf_counter() - t0) * 1e3
 
         # error feedback (collaborative/error_feedback.py): fold the last
@@ -621,24 +652,23 @@ class CollaborativeOptimizer:
 
         self.performance_ema.pause()
         try:
-            averaged, group_size = self.averager.step(
-                contrib,
-                weight=float(self.local_samples_accumulated) * weight_scale,
-                round_id=round_id,
-                # tracker's live peer count: full group => assemble the
-                # moment the last partner joins; the straggler window then
-                # only pays off when peers are genuinely late. Aux peers
-                # publish presence records and are counted — without them a
-                # full group assembles the instant the last TRAINER joins
-                # and aux donors systematically lose the race. During cold
-                # start (num_peers <= 1: our own record may be the only
-                # visible one) keep the full window so a concurrent starter
-                # can still pair with us — the design the solo-grace path
-                # above depends on. Only near-step trainers are counted —
-                # lagging peers are resyncing and must not size the group.
-                expected_size=expected_size,
-                window=window,
-            )
+            wire_start = monotonic_clock()
+            with steps.phase("avg_wire"):
+                averaged, group_size = self._sync_averager_step(
+                    contrib, weight_scale, round_id, expected_size, window,
+                )
+            if self.overlap_averaging and tele is not None:
+                # overlap ledger, synchronous-fallback form: this round ran
+                # on the trainer's critical path (cooldown after a failed
+                # overlapped round, ramp, gate, desync) — its entire wall is
+                # EXPOSED stall, efficiency 0 (docs/observability.md)
+                exposed = max(0.0, monotonic_clock() - wire_start)
+                tele.counter("opt.overlap_exposed_s").inc(exposed)
+                tele.gauge("opt.overlap_efficiency").set(0.0)
+                tele.event(
+                    "opt.overlap_ledger", round_id=round_id, mode="sync",
+                    hidden_s=0.0, exposed_s=exposed, efficiency=0.0,
+                )
             contributors = getattr(
                 self.averager, "last_contributors", group_size
             )
@@ -690,6 +720,29 @@ class CollaborativeOptimizer:
             return self._apply_and_advance(state, mean_grads, collab, group_size)
         finally:
             self.performance_ema.resume()
+
+    def _sync_averager_step(
+        self, contrib, weight_scale, round_id, expected_size, window,
+    ):
+        """The synchronous averaging round (the ``avg_wire`` step phase).
+
+        ``expected_size`` is the tracker's live peer count: full group =>
+        assemble the moment the last partner joins; the straggler window
+        then only pays off when peers are genuinely late. Aux peers publish
+        presence records and are counted — without them a full group
+        assembles the instant the last TRAINER joins and aux donors
+        systematically lose the race. During cold start (num_peers <= 1:
+        our own record may be the only visible one) the full window is kept
+        so a concurrent starter can still pair with us — the design the
+        solo-grace path depends on. Only near-step trainers are counted —
+        lagging peers are resyncing and must not size the group."""
+        return self.averager.step(
+            contrib,
+            weight=float(self.local_samples_accumulated) * weight_scale,
+            round_id=round_id,
+            expected_size=expected_size,
+            window=window,
+        )
 
     def _settle_error_feedback(self, ef_commit, group_size: int) -> None:
         """A round whose result we adopted settles the pending residual.
@@ -744,6 +797,20 @@ class CollaborativeOptimizer:
             expected_size=expected_size,
             window=window,
         )
+        # overlap ledger: round wall runs launch → future completion; the
+        # done-callback stamps completion on the resolving thread so a round
+        # that lands BETWEEN boundaries is not credited with hiding the
+        # accumulation that ran after it finished
+        self._overlap_launched_at = monotonic_clock()
+        self._overlap_hidden_s = 0.0
+        self._overlap_done_at = None
+
+        def _stamp_done(_f) -> None:
+            self._overlap_done_at = monotonic_clock()
+
+        add_done = getattr(fut, "add_done_callback", None)
+        if add_done is not None:
+            add_done(_stamp_done)
         self._overlap_inflight = {
             "future": fut,
             "named": named,  # pre-error-feedback grads, for failure restore
@@ -767,6 +834,9 @@ class CollaborativeOptimizer:
             )
         self._overlap_committed_samples = self.local_samples_accumulated
         self.local_samples_accumulated = 0
+        # from here the trainer accumulates concurrently with the round —
+        # the ledger credits launch→next-boundary wall as hidden time
+        self._overlap_resumed_at = monotonic_clock()
         return (
             state,
             zeros_like_grads(state.params),
@@ -790,6 +860,29 @@ class CollaborativeOptimizer:
         collab = inflight["collab"]
         round_id = f"step{collab.optimizer_step}"
         tele = telemetry.resolve(self.telemetry)
+        # overlap ledger: hidden = concurrent-accumulation wall credited at
+        # each boundary while the round flew (capped at the round wall);
+        # exposed = the remainder of launch→finish the compute did NOT
+        # cover. A round that landed within one boundary reports
+        # efficiency ~1; a round the trainer outpaced reports the stall.
+        done_at = self._overlap_done_at
+        if done_at is None:
+            done_at = monotonic_clock()
+        round_wall = max(0.0, done_at - self._overlap_launched_at)
+        hidden = min(self._overlap_hidden_s, round_wall)
+        exposed = max(0.0, round_wall - hidden)
+        self._overlap_hidden_s = 0.0
+        self._overlap_done_at = None
+        if tele is not None:
+            efficiency = hidden / round_wall if round_wall > 0 else 1.0
+            tele.counter("opt.overlap_hidden_s").inc(hidden)
+            tele.counter("opt.overlap_exposed_s").inc(exposed)
+            tele.gauge("opt.overlap_efficiency").set(efficiency)
+            tele.event(
+                "opt.overlap_ledger", round_id=round_id, mode="overlap",
+                hidden_s=hidden, exposed_s=exposed, efficiency=efficiency,
+                round_wall_s=round_wall,
+            )
         try:
             averaged, group_size = inflight["future"].result()
         except Exception as e:  # noqa: BLE001 — a failed round costs one
@@ -860,23 +953,25 @@ class CollaborativeOptimizer:
         (those microbatches belong to the NEXT round)."""
         round_id = f"step{collab.optimizer_step}"
         t0 = time.perf_counter()
-        # NaN-rollback backup stays ON DEVICE: an HBM copy of the pre-apply
-        # state costs ~ms, where a host round-trip of the same bytes costs
-        # seconds (and competes with the dispatch stream for PCIe). The copy
-        # is required because apply donates the input buffers.
-        pre = jax.tree.map(
-            jax.numpy.copy, (state.step, state.params, state.opt_state)
-        )
-        new_state = self._apply_fn(state, mean_grads)
-        if self.post_apply is not None:
-            new_state = self.post_apply(new_state)
-        if not bool(params_are_finite(new_state.params)):
-            # NaN guard (CollaborativeCallback.on_step_end semantics,
-            # albert/run_trainer.py:134-137): discard this update
-            logger.warning(f"{round_id}: non-finite params; rolling back")
-            new_state = new_state.replace(
-                step=pre[0], params=pre[1], opt_state=pre[2]
+        with steps.phase("opt_apply"):
+            # NaN-rollback backup stays ON DEVICE: an HBM copy of the
+            # pre-apply state costs ~ms, where a host round-trip of the same
+            # bytes costs seconds (and competes with the dispatch stream for
+            # PCIe). The copy is required because apply donates the input
+            # buffers.
+            pre = jax.tree.map(
+                jax.numpy.copy, (state.step, state.params, state.opt_state)
             )
+            new_state = self._apply_fn(state, mean_grads)
+            if self.post_apply is not None:
+                new_state = self.post_apply(new_state)
+            if not bool(params_are_finite(new_state.params)):
+                # NaN guard (CollaborativeCallback.on_step_end semantics,
+                # albert/run_trainer.py:134-137): discard this update
+                logger.warning(f"{round_id}: non-finite params; rolling back")
+                new_state = new_state.replace(
+                    step=pre[0], params=pre[1], opt_state=pre[2]
+                )
         self.seam_ms["apply"] = (time.perf_counter() - t0) * 1e3
         tele = telemetry.resolve(self.telemetry)
         if tele is not None:
@@ -892,8 +987,9 @@ class CollaborativeOptimizer:
         if keep_acc is None:
             self.local_samples_accumulated = 0
         self._backup_and_share(new_state)
-        self._report(synced=True)
-        self.tracker.fetch_collaboration_state(force=True)
+        with steps.phase("collab"):
+            self._report(synced=True)
+            self.tracker.fetch_collaboration_state(force=True)
         if self.verbose:
             logger.info(
                 f"global step {self.local_step} applied "

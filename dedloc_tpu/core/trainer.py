@@ -20,6 +20,8 @@ from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
 import jax
 
 from dedloc_tpu.core.hooks import HookList, LoopContext, default_hooks
+from dedloc_tpu.telemetry import steps
+from dedloc_tpu.telemetry.steps import StepRecorder
 from dedloc_tpu.utils.logging import get_logger
 from dedloc_tpu.utils.perf import PerfStats, profiler_trace
 
@@ -43,11 +45,16 @@ class Trainer:
         hooks: Optional[HookList] = None,
         perf: Optional[PerfStats] = None,
         profiler_dir: Optional[str] = None,
+        recorder: Optional[StepRecorder] = None,
     ):
         self.step_fn = step_fn
         self.hooks = hooks if hooks is not None else default_hooks()
         self.perf = perf if perf is not None else PerfStats()
         self.profiler_dir = profiler_dir
+        # step-phase flight recorder (telemetry/steps.py): no-op while
+        # telemetry is disabled; the default instance keeps call sites
+        # unconditional
+        self.recorder = recorder if recorder is not None else StepRecorder()
 
     def train(
         self,
@@ -78,15 +85,19 @@ class Trainer:
         return state, ctx
 
     def _one_step(self, state: Any, batches: Iterator[Any], ctx: LoopContext):
+        with self.recorder.step(step=ctx.local_step):
+            return self._one_step_inner(state, batches, ctx)
+
+    def _one_step_inner(self, state, batches, ctx):
         self.hooks.dispatch("on_step_begin", ctx)
-        with self.perf.timer("read_sample"):
+        with self.perf.timer("read_sample"), steps.phase("data_wait"):
             try:
                 batch = next(batches)
             except StopIteration:
                 ctx.should_stop = True
                 return state
         metrics: Dict[str, Any] = {}
-        with self.perf.timer("train_step"):
+        with self.perf.timer("train_step"), steps.phase("fwd_bwd"):
             state, metrics = self.step_fn(state, batch)
             # block on the loss only — the rest of the state stays async
             loss = metrics.get("loss")
@@ -104,7 +115,7 @@ class Trainer:
             for k, v in metrics.items()
             if k not in ("global_step",) and _is_scalar(v)
         }
-        with self.perf.timer("hooks"):
+        with self.perf.timer("hooks"), steps.phase("hooks"):
             # fused-step event fan-out (see module docstring)
             for event in ("on_forward", "on_loss", "on_backward", "on_update",
                           "on_step_end"):

@@ -22,7 +22,13 @@ import jax.numpy as jnp
 
 from dedloc_tpu.collaborative.metrics import LocalMetrics, publish_metrics
 from dedloc_tpu.collaborative.optimizer import CollaborativeOptimizer
+from dedloc_tpu.telemetry import steps
 from dedloc_tpu.telemetry.links import endpoint_key
+from dedloc_tpu.telemetry.steps import (
+    StepRecorder,
+    albert_tflops_per_sample,
+    chip_peak_tflops,
+)
 from dedloc_tpu.core.config import CollaborationArguments, parse_config
 from dedloc_tpu.data.streaming import peer_shuffle_seed
 from dedloc_tpu.parallel.train_step import (
@@ -335,6 +341,20 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
     # blocked on (that would serialize the async dispatch chain) — it shows
     # up in the boundary wall instead.
     perf = PerfStats()
+    # step-phase flight recorder (telemetry/steps.py): per-boundary phase
+    # decomposition + online MFU, published through the telemetry registry
+    # (no-op while telemetry is disabled). The MFU gauge uses the same
+    # analytic model-FLOPs formula and peak table as bench.py, so the
+    # in-situ number is comparable to the BENCH_r* trajectory.
+    from dedloc_tpu.data.mlm import max_predictions_for
+
+    recorder = StepRecorder(
+        telemetry=tele,
+        model_tflops_per_sample=albert_tflops_per_sample(
+            cfg, seq, max_predictions_for(seq)
+        ),
+        peak_tflops=chip_peak_tflops(),
+    )
     train_log = (
         open(args.training.train_log_path, "a", buffering=1)
         if args.training.train_log_path
@@ -343,45 +363,69 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
     wall_start = time.perf_counter()
     try:
         while True:
-            # one accumulation boundary = gradient_accumulation_steps micro-batches
+            # one accumulation boundary = gradient_accumulation_steps
+            # micro-batches; the flight recorder treats the boundary as ONE
+            # step record (data_wait/h2d/fwd_bwd here, grad_flatten/
+            # avg_wire/opt_apply/collab inside opt.step via the live
+            # step-context)
             boundary_start = time.perf_counter()
             data_wait = 0.0
-            for _ in range(args.training.gradient_accumulation_steps):
-                t0 = time.perf_counter()
-                batch = drop_collator_keys(next(batches))
-                data_wait += time.perf_counter() - t0
-                if mesh is not None:
-                    batch = put_batch(
-                        batch, mesh,
-                        seq_axis="seq" if "seq" in mesh.axis_names else None,
-                        seq_length=seq,
-                    )
-                data_rng, sub = jax.random.split(data_rng)
-                grad_acc, n_acc, metrics = accumulate(
-                    state.params, grad_acc, n_acc, batch, sub
-                )
-                loss_sum_dev = loss_sum_dev + metrics["loss"]
-                mini_steps += 1
-            # per-BOUNDARY stall so it is directly comparable to the
-            # boundary wall time below
-            perf.metric("data_wait").update(data_wait)
+            with recorder.step(
+                step=opt.local_step,
+                samples=slice_batch * args.training.gradient_accumulation_steps,
+            ) as srec:
+                for _ in range(args.training.gradient_accumulation_steps):
+                    t0 = time.perf_counter()
+                    with steps.phase("data_wait"):
+                        batch = drop_collator_keys(next(batches))
+                    data_wait += time.perf_counter() - t0
+                    if mesh is not None:
+                        with steps.phase("h2d"):
+                            batch = put_batch(
+                                batch, mesh,
+                                seq_axis=(
+                                    "seq" if "seq" in mesh.axis_names
+                                    else None
+                                ),
+                                seq_length=seq,
+                            )
+                    data_rng, sub = jax.random.split(data_rng)
+                    with steps.phase("fwd_bwd"):
+                        grad_acc, n_acc, metrics = accumulate(
+                            state.params, grad_acc, n_acc, batch, sub
+                        )
+                    loss_sum_dev = loss_sum_dev + metrics["loss"]
+                    mini_steps += 1
+                if srec is not None:
+                    # recording only: settle the async dispatch chain so
+                    # fwd_bwd measures execution, not dispatch (the
+                    # documented cost of in-situ attribution — opt.step
+                    # device_gets these grads immediately after anyway)
+                    with steps.phase("fwd_bwd"):
+                        jax.block_until_ready((grad_acc, n_acc))
+                # per-BOUNDARY stall so it is directly comparable to the
+                # boundary wall time below
+                perf.metric("data_wait").update(data_wait)
 
-            samples = (
-                slice_batch * args.training.gradient_accumulation_steps
-            )
-            t0 = time.perf_counter()
-            state, grad_acc, n_acc, stepped = opt.step(
-                state, grad_acc, n_acc, samples
-            )
-            # most boundaries are a cheap DHT progress report; the averaging
-            # round only happens when the collaboration steps — keep the two
-            # in separate metrics or the round cost is diluted ~targetN x
-            perf.metric("allreduce" if stepped else "collab_report").update(
-                time.perf_counter() - t0
-            )
-            perf.metric("boundary").update(
-                time.perf_counter() - boundary_start
-            )
+                samples = (
+                    slice_batch * args.training.gradient_accumulation_steps
+                )
+                t0 = time.perf_counter()
+                state, grad_acc, n_acc, stepped = opt.step(
+                    state, grad_acc, n_acc, samples
+                )
+                if srec is not None:
+                    srec.attrs["stepped"] = stepped
+                # most boundaries are a cheap DHT progress report; the
+                # averaging round only happens when the collaboration steps
+                # — keep the two in separate metrics or the round cost is
+                # diluted ~targetN x
+                perf.metric(
+                    "allreduce" if stepped else "collab_report"
+                ).update(time.perf_counter() - t0)
+                perf.metric("boundary").update(
+                    time.perf_counter() - boundary_start
+                )
             if stepped:
                 loss_sum = float(loss_sum_dev)  # the one sync per global step
                 loss_sum_dev = jnp.zeros([])

@@ -9,9 +9,10 @@ from __future__ import annotations
 
 from typing import Optional
 
-from dedloc_tpu.telemetry import registry
+from dedloc_tpu.telemetry import registry, steps
 from dedloc_tpu.telemetry.health import build_swarm_health, build_topology
 from dedloc_tpu.telemetry.links import LinkTable, endpoint_key
+from dedloc_tpu.telemetry.steps import StepRecorder
 from dedloc_tpu.telemetry.registry import (
     Counter,
     Gauge,
@@ -37,6 +38,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "LinkTable",
+    "StepRecorder",
     "Telemetry",
     "active",
     "adopt_trace",
@@ -54,6 +56,7 @@ __all__ = [
     "registry",
     "resolve",
     "span",
+    "steps",
     "trace_id_for",
     "uninstall",
 ]

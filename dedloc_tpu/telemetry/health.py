@@ -13,11 +13,18 @@ Record shape (see docs/observability.md):
 
     {"current_step": N,
      "peers": [{"peer": "ab12…", "step": N, "behind": 0,
-                "rpc_failures": 0.0, "rounds_attempted": 3.0, ...}, ...],
+                "rpc_failures": 0.0, "rounds_attempted": 3.0,
+                "phases": {"data_wait": 0.01, "fwd_bwd": 0.4, ...},  # mean s
+                "dominant_phase": "fwd_bwd", "mfu": 0.57,
+                "overlap_efficiency": 0.93, ...}, ...],
      "straggler": "<peer label of the worst offender, or None>",
      "retry_rate": <state-sync retries / attempts, swarm-wide>,
      "round_formation_s": <mean mm.form_group latency across peers>,
      "faults_injected": <total fault events (test harnesses only)>}
+
+The ``phases``/``dominant_phase``/``mfu``/``overlap_*`` fields come from the
+step-phase flight recorder (``telemetry/steps.py``); peers on pre-recorder
+builds simply lack them — their rows fold unchanged.
 """
 from __future__ import annotations
 
@@ -61,6 +68,35 @@ def _peer_entry(m, current_step: int) -> Dict:
     round_dur = t.get("avg.round.mean")
     if round_dur is not None:
         entry["round_s"] = float(round_dur)
+    # step-phase flight recorder (telemetry/steps.py): per-phase mean
+    # seconds from the snapshot's ``step.phase.<name>.mean`` histogram keys,
+    # plus the dominant phase — the coordinator-side half of "why was step N
+    # slow now ends in a PHASE". Absent for pre-recorder peers (no keys).
+    phases = {}
+    for key, value in t.items():
+        if (
+            isinstance(key, str)
+            and key.startswith("step.phase.")
+            and key.endswith(".mean")
+        ):
+            try:
+                phases[key[len("step.phase."):-len(".mean")]] = float(value)
+            except (TypeError, ValueError):
+                continue
+    if phases:
+        entry["phases"] = phases
+        entry["dominant_phase"] = max(phases, key=phases.get)
+    mfu = t.get("step.mfu")
+    if mfu is not None:
+        entry["mfu"] = float(mfu)
+    # overlap ledger (collaborative optimizer): cumulative hidden/exposed
+    # averaging seconds → lifetime overlap efficiency for this peer
+    hidden = float(t.get("opt.overlap_hidden_s", 0.0))
+    exposed = float(t.get("opt.overlap_exposed_s", 0.0))
+    if hidden or exposed:
+        entry["overlap_hidden_s"] = hidden
+        entry["overlap_exposed_s"] = exposed
+        entry["overlap_efficiency"] = hidden / (hidden + exposed)
     return entry
 
 

@@ -15,14 +15,25 @@ TPU-native differences from the reference:
 - whole-program tracing goes through ``jax.profiler`` (xplane traces viewable
   in tensorboard/xprof) behind one config flag — the §5 "tracing behind one
   flag" requirement — instead of per-op CUDA events.
+
+Unified with the swarm-telemetry clock (docs/observability.md): PerfStats
+times on ``telemetry.registry.monotonic_clock`` — real monotonic time in
+production, FakeClock-offset-aware in fault scenarios — and, whenever a
+telemetry registry is active (process-global or injected via
+``telemetry=``), every block timing is ALSO observed into that registry's
+``perf.<name>`` histogram. One clock source, one sink: the timings ride the
+metrics-bus snapshot and the per-peer event trace instead of living only in
+this object's private store (kept for the human ``report_str`` view and the
+roles' recent-mean publishing).
 """
 from __future__ import annotations
 
 import math
-import time
 from collections import deque
 from contextlib import contextmanager
 from typing import Any, Deque, Dict, Iterator, Optional
+
+from dedloc_tpu.telemetry import registry as _telemetry
 
 
 class PerfMetric:
@@ -77,9 +88,13 @@ class PerfStats:
             loss = step(...)
     """
 
-    def __init__(self, enabled: bool = True) -> None:
+    def __init__(self, enabled: bool = True, telemetry=None) -> None:
         self.enabled = enabled
         self.metrics: Dict[str, PerfMetric] = {}
+        # component-scoped telemetry registry; None resolves the process
+        # global at each timing (so a registry installed AFTER this object
+        # was built — the usual role startup order — still receives them)
+        self._telemetry = telemetry
 
     def metric(self, name: str) -> PerfMetric:
         if name not in self.metrics:
@@ -93,7 +108,7 @@ class PerfStats:
         if not self.enabled:
             yield
             return
-        start = time.perf_counter()
+        start = _telemetry.monotonic_clock()
         try:
             yield
         finally:
@@ -101,7 +116,15 @@ class PerfStats:
                 import jax
 
                 jax.block_until_ready(block_on)
-            self.metric(name).update(time.perf_counter() - start)
+            # clamp at 0: a block straddling a FakeClock exit sees the
+            # clock retreat by the whole fake offset
+            dur = max(0.0, _telemetry.monotonic_clock() - start)
+            self.metric(name).update(dur)
+            tele = _telemetry.resolve(self._telemetry)
+            if tele is not None:
+                # the unified sink: the same timing rides the registry
+                # (snapshot key ``perf.<name>.mean`` etc.)
+                tele.histogram(f"perf.{name}").observe(dur)
 
     def report(self) -> Dict[str, Dict[str, float]]:
         return {name: m.summary() for name, m in sorted(self.metrics.items())}

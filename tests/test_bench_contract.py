@@ -154,3 +154,89 @@ def test_bench_allreduce_pipeline_beats_monolithic():
     assert record["pipelined_wall_ms"] > 0
     assert record["monolithic_wall_ms"] > 0
     assert record["pipelined_wall_ms"] < record["monolithic_wall_ms"], record
+
+
+# ------------------------------------------------------------- bench gate
+# (tools/bench_gate.py: the perf trajectory is machine-guarded, mirroring
+# t1_budget.py --gate. Deterministic half only — these tests gate COMMITTED
+# BENCH_r*.json artifacts and synthetic JSONs, they never run the bench.)
+
+import importlib.util
+
+_REPO = os.path.join(os.path.dirname(__file__), "..")
+_spec = importlib.util.spec_from_file_location(
+    "bench_gate", os.path.join(_REPO, "tools", "bench_gate.py")
+)
+bench_gate = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench_gate)
+
+
+def _bench_paths(*rounds):
+    return [os.path.join(_REPO, f"BENCH_r{r:02d}.json") for r in rounds]
+
+
+def test_bench_gate_passes_on_real_trajectory():
+    """Acceptance: the committed BENCH trajectory gates clean — the best
+    recorded round vs the default BENCH_r*.json glob exits 0. The fresh
+    round is picked dynamically (highest samples/sec) so committing an
+    improved BENCH_r06.json later cannot break this test."""
+    import glob as globmod
+
+    rounds = sorted(globmod.glob(os.path.join(_REPO, "BENCH_r*.json")))
+    loaded = [(p, bench_gate.load_bench(p)) for p in rounds]
+    best = max(
+        (pr for pr in loaded if pr[1] is not None),
+        key=lambda pr: pr[1]["value"],
+    )[0]
+    assert bench_gate.main([best]) == 0
+
+
+def test_bench_gate_catches_synthetic_regression(tmp_path, capsys):
+    """Acceptance: a fresh bench JSON regressed >3% on samples/sec exits
+    nonzero (and an MFU-only regression is caught independently)."""
+    slow = tmp_path / "slow.json"
+    slow.write_text(json.dumps({
+        "metric": "albert_large_train_samples_per_sec_per_chip",
+        "value": 100.0, "unit": "samples/sec", "vs_baseline": 10.0,
+    }))
+    assert bench_gate.main([str(slow)]) == 1
+    assert "GATE FAILED" in capsys.readouterr().out
+    low_mfu = tmp_path / "low_mfu.json"
+    low_mfu.write_text(json.dumps({
+        "metric": "albert_large_train_samples_per_sec_per_chip",
+        "value": 112.6, "unit": "samples/sec", "vs_baseline": 11.3,
+        "mfu": 0.50,
+    }))
+    assert bench_gate.main([str(low_mfu)]) == 1
+    assert "MFU regressed" in capsys.readouterr().out
+
+
+def test_bench_gate_tolerates_missing_rounds():
+    """A sparse trajectory (pruned/missing rounds) still gates: r04 vs only
+    {r01, r04} passes without r02/r03/r05 existing in the baseline set."""
+    assert bench_gate.main(_bench_paths(4) + _bench_paths(1, 4)) == 0
+
+
+def test_bench_gate_malformed_baseline_warns_not_wedges(tmp_path, capsys):
+    """A corrupt baseline artifact warns on stderr and is skipped; the gate
+    still judges against the healthy baselines. A corrupt FRESH file is a
+    hard error (it IS the thing under test)."""
+    garbage = tmp_path / "garbage.json"
+    garbage.write_text("{not json")
+    rc = bench_gate.main(_bench_paths(5) + [str(garbage)] + _bench_paths(4))
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "skipping" in captured.err and "garbage.json" in captured.err
+    assert bench_gate.main([str(garbage)] + _bench_paths(4)) == 2
+
+
+def test_bench_gate_unknown_metric_warns_and_passes(tmp_path, capsys):
+    """A brand-new metric has no comparable baseline: warn, don't wedge
+    (the t1_budget missing-test contract)."""
+    novel = tmp_path / "novel.json"
+    novel.write_text(json.dumps({
+        "metric": "some_new_bench_metric", "value": 1.0,
+        "unit": "things/sec", "vs_baseline": 1.0,
+    }))
+    assert bench_gate.main([str(novel)]) == 0
+    assert "no comparable baseline" in capsys.readouterr().out

@@ -74,3 +74,47 @@ def test_perf_metric_window_and_extremes():
     assert s["mean_ms"] == pytest.approx(200.0)
     # empty metric reports 0 min (not inf) so tables never print "inf"
     assert PerfMetric().summary()["min_ms"] == 0.0
+
+
+def test_timer_emits_through_active_telemetry_registry():
+    """Unified timing systems (ISSUE 10 satellite): when a telemetry
+    registry is active, every PerfStats block timing is ALSO observed into
+    its ``perf.<name>`` histogram — one clock source (the FakeClock-aware
+    registry monotonic clock), one sink on the metrics bus — instead of
+    living only in PerfStats' private store."""
+    from dedloc_tpu.telemetry import registry
+    from dedloc_tpu.telemetry.registry import Telemetry
+    from dedloc_tpu.testing.faults import FakeClock
+
+    tele = registry.install(Telemetry(peer="perf"))
+    try:
+        stats = PerfStats()
+        with FakeClock() as clock:
+            with stats.timer("boundary"):
+                clock.advance(2.0)
+        # the private store still feeds report_str/recent_mean consumers...
+        assert stats.metric("boundary").total == pytest.approx(2.0, abs=0.1)
+        # ...and the SAME timing (same clock: the fake advance is visible)
+        # landed in the registry histogram that rides snapshots
+        h = tele.histograms["perf.boundary"]
+        assert h.count == 1
+        assert h.total == pytest.approx(2.0, abs=0.1)
+        assert "perf.boundary.mean" in tele.snapshot()
+    finally:
+        registry.uninstall(tele)
+
+
+def test_timer_component_scoped_registry_wins_over_global():
+    from dedloc_tpu.telemetry import registry
+    from dedloc_tpu.telemetry.registry import Telemetry
+
+    scoped = Telemetry(peer="scoped")
+    installed = registry.install(Telemetry(peer="global"))
+    try:
+        stats = PerfStats(telemetry=scoped)
+        with stats.timer("x"):
+            pass
+        assert "perf.x" in scoped.histograms
+        assert "perf.x" not in installed.histograms
+    finally:
+        registry.uninstall(installed)

@@ -529,6 +529,76 @@ def test_sim_port_scale_32_peers_concurrent_groups_with_churn(sim_swarm):
     assert len(multi) >= 2, "expected multiple concurrent groups"
 
 
+def test_sim_port_concurrent_leaders_dissolve_into_one_group(sim_swarm):
+    """Sim port of test_averaging.py::
+    test_concurrent_leaders_with_followers_dissolve_into_one_group (a known
+    order/timing-sensitive threaded race on the single-core tier-1 box —
+    now slow-marked). Same contract, virtual clock: two peers miss each
+    other's leadership entry and BOTH lead, each picking up a follower (one
+    follower deliberately joins the WORST-ranked leader); the worse leader
+    must DISSOLVE — its joiners fail fast and re-join the better leader —
+    so ONE full group forms well inside the straggler window instead of
+    two partial groups deadlocking until it expires."""
+    WINDOW = 25.0
+    engine, swarm = sim_swarm(4, seed=11)
+    for peer in swarm.peers:
+        peer.attach_matchmaking("dissolve", target_group_size=4,
+                                averaging_expiration=WINDOW)
+    # force the race: peers 0 and 1 see NO live leaders on their first
+    # lookup, so both decide to lead
+    for peer in swarm.peers[:2]:
+        mm = peer.matchmaking
+        orig = mm._live_leaders
+        state = {"first": True}
+
+        async def blind_once(round_id, _orig=orig, _state=state):
+            if _state["first"]:
+                _state["first"] = False
+                return []
+            return await _orig(round_id)
+
+        mm._live_leaders = blind_once
+
+    # force the SPLIT: follower 3 joins the WORST-ranked leader (reversed
+    # view), so one leader certainly ends up with a follower it must kick
+    # when it dissolves — the exact deadlock shape from the w120 probe
+    mm3 = swarm.peers[3].matchmaking
+    orig3 = mm3._live_leaders
+
+    async def reversed_view(round_id):
+        return list(reversed(await orig3(round_id)))
+
+    mm3._live_leaders = reversed_view
+
+    async def scenario():
+        async def form(peer, delay):
+            # followers start after the contested leaderships are published
+            # (virtual seconds — the sim engine jumps, nobody sleeps)
+            await asyncio.sleep(delay)
+            return await peer.matchmaking.form_group("r0", expected_size=4)
+
+        return await asyncio.gather(*(
+            asyncio.ensure_future(form(p, 0.0 if i < 2 else 0.5))
+            for i, p in enumerate(swarm.peers)
+        ))
+
+    t0 = get_dht_time()
+    groups = engine.run(scenario())
+    elapsed = get_dht_time() - t0
+    sizes = sorted(len(g.members) for g in groups)
+    assert sizes == [4, 4, 4, 4], (
+        f"expected one full group of 4, got group sizes {sizes} "
+        "(a partial-group deadlock)"
+    )
+    rosters = {tuple(m.peer_id for m in g.members) for g in groups}
+    assert len(rosters) == 1, f"inconsistent rosters: {rosters}"
+    # the whole point: assembly must not idle out the straggler window
+    assert elapsed < WINDOW, (
+        f"group formed only after the straggler window ({elapsed:.1f}s "
+        "virtual)"
+    )
+
+
 def test_sim_port_client_mode_peers_collaborate_via_relay(sim_swarm):
     """Sim port of test_roles.py::
     test_client_mode_trainer_collaborates_via_relay (the #1 tier-1

@@ -1,0 +1,184 @@
+"""Bench regression gate: fail CI when a fresh bench run regresses the
+recorded perf trajectory.
+
+The BENCH_r*.json trajectory (85.5 → 112.6 samples/sec/chip, MFU 0.435 →
+0.576 over r01–r05) is the repo's perf contract, but until now it was
+eyeballed — a PR that silently cost 5% throughput would only surface when a
+human diffed the JSONs. This tool machine-guards it, mirroring
+``tools/t1_budget.py --gate``:
+
+    # gate a fresh bench JSON against the committed trajectory
+    python bench.py > /tmp/fresh.txt   # or any file holding the JSON line
+    python tools/bench_gate.py /tmp/fresh.json
+    # explicit baselines + custom tolerance
+    python tools/bench_gate.py --tolerance 0.05 fresh.json BENCH_r04.json ...
+
+Exit code 0 when the fresh run's ``value`` (samples/sec) and ``mfu`` (when
+both sides have one) are within ``--tolerance`` (default 0.03 = −3%) of the
+BEST comparable baseline round; 1 on a regression. Robustness contract,
+same spirit as the t1 gate:
+
+- baseline rounds are filtered to the fresh run's ``metric`` name — a
+  distributed-path bench never gates against the single-chip headline;
+- a missing round (sparse glob, pruned file) is simply absent from the
+  baseline set, never an error;
+- a malformed baseline JSON warns on stderr and is skipped — a corrupt
+  artifact must not wedge the gate (a malformed FRESH file fails: that is
+  the thing under test);
+- no comparable baseline at all warns and exits 0 (nothing to gate
+  against — the bootstrap case for a brand-new metric).
+
+Accepted file shapes: a driver record (``{"n": 5, "parsed": {...}}``,
+the BENCH_r*.json layout), the bare bench line (``{"metric": ...,
+"value": ...}``), or a file whose last ``{``-prefixed line is that bench
+line (raw ``python bench.py`` output).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE_GLOB = os.path.join(REPO_ROOT, "BENCH_r*.json")
+
+
+def load_bench(path: str) -> Optional[Dict]:
+    """The bench record in ``path``, or None (with a stderr warning) when
+    the file is unreadable/malformed — see the robustness contract above."""
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+    except OSError as e:
+        print(f"warning: skipping {path}: {e}", file=sys.stderr)
+        return None
+    record = None
+    try:
+        record = json.loads(text)
+    except ValueError:
+        # raw bench stdout: the bench contract is ONE {-prefixed JSON line
+        # (test_bench_contract.py); take the last one so warmup noise and
+        # jax warnings above it don't matter
+        for line in reversed(text.strip().splitlines()):
+            if line.startswith("{"):
+                try:
+                    record = json.loads(line)
+                except ValueError:
+                    pass
+                break
+    if isinstance(record, dict) and isinstance(record.get("parsed"), dict):
+        record = record["parsed"]  # BENCH_r*.json driver layout
+    if (
+        not isinstance(record, dict)
+        or "metric" not in record
+        or not isinstance(record.get("value"), (int, float))
+    ):
+        print(f"warning: skipping {path}: not a bench record", file=sys.stderr)
+        return None
+    return record
+
+
+def best_baseline(
+    records: List[Dict], metric: str
+) -> Tuple[Optional[float], Optional[float]]:
+    """(best value, best mfu) over the comparable baseline rounds."""
+    values = [
+        float(r["value"]) for r in records if r.get("metric") == metric
+    ]
+    mfus = [
+        float(r["mfu"]) for r in records
+        if r.get("metric") == metric
+        and isinstance(r.get("mfu"), (int, float))
+    ]
+    return (max(values) if values else None, max(mfus) if mfus else None)
+
+
+def gate(
+    fresh: Dict, baselines: List[Dict], tolerance: float = 0.03
+) -> Tuple[str, int]:
+    """(report text, exit code): 0 within tolerance, 1 on regression."""
+    out: List[str] = []
+    metric = fresh.get("metric", "?")
+    base_value, base_mfu = best_baseline(baselines, metric)
+    if base_value is None:
+        out.append(
+            f"warning: no comparable baseline for metric {metric!r} — "
+            "nothing to gate against (bootstrap case)"
+        )
+        return "\n".join(out), 0
+    failures: List[str] = []
+    value = float(fresh["value"])
+    floor = base_value * (1.0 - tolerance)
+    if value < floor:
+        failures.append(
+            f"samples/sec regressed: {value:.3f} vs best baseline "
+            f"{base_value:.3f} (floor {floor:.3f}, "
+            f"{(1.0 - value / base_value) * 100.0:.1f}% drop)"
+        )
+    else:
+        out.append(
+            f"ok: value {value:.3f} vs best baseline {base_value:.3f} "
+            f"(floor {floor:.3f})"
+        )
+    mfu = fresh.get("mfu")
+    if isinstance(mfu, (int, float)) and base_mfu is not None:
+        mfu_floor = base_mfu * (1.0 - tolerance)
+        if float(mfu) < mfu_floor:
+            failures.append(
+                f"MFU regressed: {float(mfu):.4f} vs best baseline "
+                f"{base_mfu:.4f} (floor {mfu_floor:.4f})"
+            )
+        else:
+            out.append(
+                f"ok: mfu {float(mfu):.4f} vs best baseline {base_mfu:.4f} "
+                f"(floor {mfu_floor:.4f})"
+            )
+    elif base_mfu is not None:
+        # CPU smoke runs have no MFU block — the value check still gates
+        out.append("note: fresh record has no mfu field; MFU not gated")
+    if failures:
+        out.append("")
+        out.append(
+            f"GATE FAILED: the perf trajectory must not silently regress "
+            f"more than {tolerance * 100.0:.0f}% (ROADMAP item 4):"
+        )
+        out.extend(f"  {f}" for f in failures)
+        return "\n".join(out), 1
+    out.append("gate passed")
+    return "\n".join(out), 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+    )
+    parser.add_argument(
+        "fresh", help="fresh bench JSON (or raw bench stdout) to gate"
+    )
+    parser.add_argument(
+        "baselines", nargs="*",
+        help=f"baseline bench JSONs (default: {DEFAULT_BASELINE_GLOB})",
+    )
+    parser.add_argument(
+        "--tolerance", type=float, default=0.03,
+        help="fractional regression allowed vs the best baseline "
+             "(0.03 = -3%%)",
+    )
+    args = parser.parse_args(argv)
+    fresh = load_bench(args.fresh)
+    if fresh is None:
+        print(f"error: fresh bench file {args.fresh} is not a bench record",
+              file=sys.stderr)
+        return 2
+    paths = args.baselines or sorted(glob.glob(DEFAULT_BASELINE_GLOB))
+    baselines = [r for r in (load_bench(p) for p in paths) if r is not None]
+    text, code = gate(fresh, baselines, tolerance=args.tolerance)
+    print(text)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,7 @@ Usage:
     python tools/runlog_summary.py --health events.jsonl [events2.jsonl ...]
     python tools/runlog_summary.py --trace ROUND_ID events.jsonl [...]
     python tools/runlog_summary.py --topology events.jsonl [...]
+    python tools/runlog_summary.py --steps events.jsonl [...]
 
 Default mode prints a markdown `| global step | wall (min) | loss |` table at
 the given checkpoints (default: a log-spaced selection plus the final step)
@@ -35,7 +36,17 @@ already folded the per-peer views): per-link RTT/goodput estimates ranked
 worst-first, low-RTT clique candidates, and fat/thin peers — the input the
 hierarchical matchmaker reads (ROADMAP item 1).
 
-All three telemetry views share ONE hardened loader: truncated final lines
+``--steps`` renders the step-phase flight recorder's view (per-step
+``step.record`` / ``step.phase`` events from ``telemetry/steps.py``, or a
+coordinator metrics JSONL whose ``swarm_health.peers[].phases`` already
+folded the per-peer means): a step-time waterfall per peer with the
+dominant phase named, the phase-skew ranking across peers (which peer's
+phase is furthest off the swarm median — the "who is stalling us and WHY"
+answer), and the overlap-averaging ledger per boundary (hidden vs exposed
+averaging wall, efficiency) — the debug ladder's final rung: swarm → round
+→ link → *phase*.
+
+All telemetry views share ONE hardened loader: truncated final lines
 (a peer killed mid-write) and interleaved/jammed lines (two writers on one
 file) are skipped or split, never fatal.
 """
@@ -642,6 +653,231 @@ def print_topology(all_rows):
                 print(f"  thin: {p} ({_fmt_rate(means[p])})")
 
 
+# ----------------------------------------------------------------- steps view
+# (step-phase flight recorder: telemetry/steps.py. One step.record event per
+# step carries {phases: {name: s}, untimed_s, samples, dur_s}; the
+# coordinator's swarm_health.peers[].phases carries the folded means.)
+
+_CANONICAL_PHASES = (
+    "data_wait", "h2d", "fwd_bwd", "grad_flatten", "avg_wire", "opt_apply",
+    "collab",
+)
+
+
+def _phase_order(names):
+    """Canonical pipeline order first, then any extra phases alphabetically."""
+    extra = sorted(n for n in names if n not in _CANONICAL_PHASES)
+    return [n for n in _CANONICAL_PHASES if n in names] + extra
+
+
+def _steps_from_events(rows):
+    """{peer: {"steps": n, "wall": mean_s|None, "untimed": mean_s|None,
+    "phases": {name: mean_s}}} from step.record events. Per-PEER fallback:
+    a peer whose step.record rows were lost (truncated/jammed log — the
+    churn these views debug) is rebuilt from its bare step.phase events
+    (phase means only, no wall/untimed) instead of silently vanishing
+    from the waterfall next to healthier peers."""
+    per_peer = {}
+    for r in rows:
+        if r.get("event") != "step.record":
+            continue
+        acc = per_peer.setdefault(
+            r.get("peer", "?"),
+            {"steps": 0, "wall": 0.0, "untimed": 0.0, "phases": {}},
+        )
+        acc["steps"] += 1
+        acc["wall"] += float(r.get("dur_s", 0.0))
+        acc["untimed"] += float(r.get("untimed_s", 0.0))
+        phases = r.get("phases") or {}
+        for name, dur in phases.items():
+            try:
+                acc["phases"][name] = (
+                    acc["phases"].get(name, 0.0) + float(dur)
+                )
+            except (TypeError, ValueError):
+                continue
+        if r.get("mfu") is not None:
+            acc["mfu"] = float(r["mfu"])  # latest online gauge wins
+    for acc in per_peer.values():
+        n = acc["steps"]
+        acc["wall"] /= n
+        acc["untimed"] /= n
+        acc["phases"] = {k: v / n for k, v in acc["phases"].items()}
+    # degraded peers: only per-phase events survive for them
+    fallback, counts = {}, {}
+    for r in rows:
+        peer = r.get("peer", "?")
+        if (
+            r.get("event") != "step.phase" or not r.get("phase")
+            or peer in per_peer
+        ):
+            continue
+        acc = fallback.setdefault(
+            peer, {"steps": 0, "wall": None, "untimed": None, "phases": {}},
+        )
+        name = str(r["phase"])
+        acc["phases"][name] = acc["phases"].get(name, 0.0) + float(
+            r.get("dur_s", 0.0)
+        )
+        counts.setdefault(peer, {})
+        counts[peer][name] = counts[peer].get(name, 0) + 1
+    for peer, acc in fallback.items():
+        acc["steps"] = max(counts[peer].values())
+        acc["phases"] = {
+            k: v / counts[peer][k] for k, v in acc["phases"].items()
+        }
+        per_peer[peer] = acc
+    return per_peer
+
+
+def _steps_from_health(all_rows):
+    """Per-peer phase means from the NEWEST swarm_health record that
+    carries any (coordinator metrics JSONL input)."""
+    per_peer = {}
+    for row in all_rows:
+        health = row.get("swarm_health")
+        if not isinstance(health, dict):
+            continue
+        found = {}
+        for p in health.get("peers", []):
+            phases = p.get("phases")
+            if not isinstance(phases, dict) or not phases:
+                continue
+            entry = {
+                "steps": None,
+                "wall": (
+                    p["step_time_ms"] / 1e3
+                    if p.get("step_time_ms") is not None else None
+                ),
+                "untimed": None,
+                "phases": {k: float(v) for k, v in phases.items()},
+            }
+            if p.get("mfu") is not None:
+                entry["mfu"] = float(p["mfu"])
+            if p.get("overlap_efficiency") is not None:
+                entry["overlap_efficiency"] = float(p["overlap_efficiency"])
+            found[p.get("peer", "?")] = entry
+        if found:
+            per_peer = found  # newest record wins
+    return per_peer
+
+
+def _bar(value, full, width=24):
+    if not full or full <= 0:
+        return ""
+    n = int(round(width * min(1.0, value / full)))
+    return "#" * max(n, 1 if value > 0 else 0)
+
+
+def print_steps(all_rows):
+    event_rows = [r for r in all_rows if "event" in r]
+    per_peer = _steps_from_events(event_rows)
+    if not per_peer:
+        per_peer = _steps_from_health(all_rows)
+    if not per_peer:
+        sys.exit(
+            "no step-phase telemetry found (step.record events appear when "
+            "--telemetry.enabled is set on a trainer; a coordinator metrics "
+            "JSONL needs swarm_health.peers[].phases)"
+        )
+
+    print("step-time waterfall (mean per step):")
+    for peer in sorted(per_peer):
+        acc = per_peer[peer]
+        phases = acc["phases"]
+        dominant = max(phases, key=phases.get) if phases else None
+        total = sum(phases.values())
+        wall = acc.get("wall")
+        header = f"peer {peer}"
+        if acc.get("steps"):
+            header += f"  steps={acc['steps']}"
+        if wall is not None:
+            header += f"  wall {wall:.3f}s"
+        if dominant is not None:
+            share = phases[dominant] / (wall or total or 1.0)
+            header += f"  dominant {dominant} ({share * 100.0:.0f}%)"
+        if acc.get("mfu") is not None:
+            header += f"  mfu {acc['mfu']:.3f}"
+        print(header)
+        full = wall if wall is not None else total
+        for name in _phase_order(phases):
+            print(f"  {name:<14} {phases[name]:9.3f}s  "
+                  f"{_bar(phases[name], full)}")
+        if acc.get("untimed") is not None and wall:
+            covered = 100.0 * (wall - acc["untimed"]) / wall
+            print(f"  {'(untimed)':<14} {acc['untimed']:9.3f}s  "
+                  f"phase coverage {covered:.1f}% of wall")
+
+    # phase skew: for every phase, the peer furthest above the swarm median
+    # — the cross-peer "who is slow and WHY" ranking (DeDLOC heterogeneous
+    # volunteers: per-peer phase skew is the first-order signal)
+    if len(per_peer) >= 2:
+        all_names = sorted({
+            n for acc in per_peer.values() for n in acc["phases"]
+        })
+        skews = []
+        for name in all_names:
+            vals = {
+                peer: acc["phases"][name]
+                for peer, acc in per_peer.items() if name in acc["phases"]
+            }
+            if len(vals) < 2:
+                continue
+            worst_peer = max(vals, key=vals.get)
+            worst = vals[worst_peer]
+            if worst <= 0:
+                continue
+            # median of the OTHER peers: the worst offender must not drag
+            # the reference point toward itself (with 2 peers an inclusive
+            # median IS the worst value and every ratio reads 1.0x)
+            rest = sorted(v for p, v in vals.items() if p != worst_peer)
+            median = rest[len(rest) // 2]
+            ratio = worst / median if median > 0 else float("inf")
+            skews.append((ratio, name, worst_peer, worst, median))
+        skews.sort(key=lambda s: -s[0])
+        if skews:
+            print("\nphase skew across peers (worst vs median, "
+                  "most skewed first):")
+            for ratio, name, peer, worst, median in skews:
+                ratio_s = f"{ratio:.1f}x" if ratio != float("inf") else "inf"
+                print(f"  {name:<14} {peer}: {worst:.3f}s vs median "
+                      f"{median:.3f}s ({ratio_s})")
+
+    # overlap ledger: hidden vs exposed averaging wall per boundary
+    # (opt.overlap_ledger events; sync-fallback boundaries report
+    # efficiency 0 — the round ran on the critical path)
+    ledgers = [r for r in event_rows if r.get("event") == "opt.overlap_ledger"]
+    if ledgers:
+        t0 = min(r.get("t", 0.0) for r in ledgers)
+        print("\noverlap ledger (per boundary):")
+        print("| t | peer | round | mode | hidden | exposed | efficiency |")
+        print("|---|---|---|---|---|---|---|")
+        for r in sorted(ledgers, key=lambda r: r.get("t", 0.0)):
+            print(
+                f"| +{r.get('t', 0.0) - t0:.2f}s | {r.get('peer', '?')} |"
+                f" {r.get('round_id', '?')} | {r.get('mode', '?')} |"
+                f" {r.get('hidden_s', 0.0):.3f}s |"
+                f" {r.get('exposed_s', 0.0):.3f}s |"
+                f" {r.get('efficiency', 0.0):.2f} |"
+            )
+        hidden = sum(float(r.get("hidden_s", 0.0)) for r in ledgers)
+        exposed = sum(float(r.get("exposed_s", 0.0)) for r in ledgers)
+        if hidden + exposed > 0:
+            print(f"overall overlap efficiency: "
+                  f"{hidden / (hidden + exposed):.2f} "
+                  f"({hidden:.3f}s hidden / {exposed:.3f}s exposed)")
+    else:
+        effs = {
+            peer: acc["overlap_efficiency"]
+            for peer, acc in per_peer.items()
+            if acc.get("overlap_efficiency") is not None
+        }
+        if effs:
+            print("\noverlap efficiency (lifetime, per peer):")
+            for peer in sorted(effs):
+                print(f"  {peer}: {effs[peer]:.2f}")
+
+
 def main(argv):
     if argv and argv[0] == "--health":
         if not argv[1:]:
@@ -659,6 +895,11 @@ def main(argv):
         if not argv[1:]:
             sys.exit("usage: runlog_summary.py --topology events.jsonl [...]")
         print_topology(load_jsonl_rows(argv[1:]))
+        return
+    if argv and argv[0] == "--steps":
+        if not argv[1:]:
+            sys.exit("usage: runlog_summary.py --steps events.jsonl [...]")
+        print_steps(load_jsonl_rows(argv[1:]))
         return
     rows = load(argv[0])
     if not rows:
