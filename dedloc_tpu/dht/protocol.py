@@ -324,11 +324,15 @@ class RPCClient:
         async with lock:
             if endpoint in self._conns:
                 return self._conns[endpoint]
-            # monotonic_clock, not perf_counter: it also advances with the
-            # fake-clock offset, so under the simulator engine the sampled
-            # RTT reflects the MODELED link latency (production offset is 0
-            # — identical to a raw monotonic read there)
-            t0 = telemetry.monotonic_clock()
+            # the LOOP's clock, not perf_counter: under the simulator
+            # engine loop.time() IS the virtual clock, so the sampled RTT
+            # reflects the MODELED link latency exactly — with none of the
+            # event-loop scheduling churn a real-clock read would add on a
+            # busy loop (noise that a twin fitted from this estimate would
+            # then pay a second time on replay). In production loop.time()
+            # is the ordinary monotonic clock.
+            loop = asyncio.get_event_loop()
+            t0 = loop.time()
             reader, writer = await self.transport.open_connection(
                 endpoint, timeout=self.request_timeout
             )
@@ -338,7 +342,7 @@ class RPCClient:
                 # per-link RTT estimate's "piggybacked ping" (one sample per
                 # pooled connection, zero traffic added to the hot path)
                 tele.links().observe_rtt(
-                    endpoint, max(0.0, telemetry.monotonic_clock() - t0)
+                    endpoint, max(0.0, loop.time() - t0)
                 )
             _set_nodelay(writer)
             self._conns[endpoint] = (reader, writer)

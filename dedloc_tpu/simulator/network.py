@@ -43,6 +43,16 @@ logger = get_logger(__name__)
 # modeled latency
 _STREAM_STEP_S = 1e-5
 
+# frames at or below this ride PAST the uplink queue (they still pay their
+# own transmit time and latency): a real network packetizes, so a 30-byte
+# RPC ack interleaves after at most one MTU of a bulk transfer instead of
+# waiting behind megabytes of queued frames. Strict whole-frame FIFO is
+# not how TCP behaves across connections, and it would poison every
+# RTT/goodput estimate measured over request/ack round trips (the
+# telemetry a digital twin is fitted from). Their skipped queue time is
+# bandwidth noise by construction (<= one MTU-ish frame).
+_SMALL_FRAME_BYTES = 1024
+
 
 @dataclass
 class LinkSpec:
@@ -64,6 +74,43 @@ class LinkSpec:
             bandwidth_bps=float(raw.get("bandwidth_bps", 0.0)),
             loss=float(raw.get("loss", 0.0)),
             jitter_s=float(raw.get("jitter_s", 0.0)),
+        )
+
+    @classmethod
+    def from_estimate(
+        cls,
+        rtt_s: Optional[float] = None,
+        rtt_jitter_s: Optional[float] = None,
+        goodput_bps: Optional[float] = None,
+        loss: Optional[float] = None,
+        default: Optional["LinkSpec"] = None,
+    ) -> "LinkSpec":
+        """A link spec from TELEMETRY estimates (telemetry/links.py fields,
+        or a fitted TwinModel link) rather than hand-written scenario
+        numbers: one-way latency is half the measured RTT and one-way
+        jitter half the RTT-deviation EWMA (both inputs are ROUND-TRIP
+        measurements), the serialized uplink rate is the measured goodput,
+        and any missing estimate falls back to ``default``'s field (an
+        unmeasured dimension keeps the fleet-default behavior instead of
+        silently becoming ideal)."""
+        default = default or cls()
+        return cls(
+            latency_s=(
+                max(1e-6, float(rtt_s) / 2.0)
+                if rtt_s is not None else default.latency_s
+            ),
+            bandwidth_bps=(
+                max(1.0, float(goodput_bps))
+                if goodput_bps is not None else default.bandwidth_bps
+            ),
+            loss=(
+                min(0.5, max(0.0, float(loss)))
+                if loss is not None else default.loss
+            ),
+            jitter_s=(
+                max(0.0, float(rtt_jitter_s) / 2.0)
+                if rtt_jitter_s is not None else default.jitter_s
+            ),
         )
 
 
@@ -187,10 +234,17 @@ class SimNetwork:
     totals for the sizing report (bytes/frames per directed host pair,
     drops)."""
 
-    def __init__(self, seed: int = 0, default_link: Optional[LinkSpec] = None):
+    def __init__(
+        self,
+        seed: int = 0,
+        default_link: Optional[LinkSpec] = None,
+        links: Optional[Dict[Tuple[str, str], LinkSpec]] = None,
+    ):
         self.rng = random.Random(seed ^ 0x5EED_0DE)
         self.default_link = default_link or LinkSpec()
-        self._links: Dict[Tuple[str, str], LinkSpec] = {}
+        # per-directed-link overrides, e.g. a whole fitted TwinModel link
+        # table ({(src_host, dst_host): LinkSpec}); set_link adds more
+        self._links: Dict[Tuple[str, str], LinkSpec] = dict(links or {})
         self._listeners: Dict[Endpoint, _SimListener] = {}
         # live connections indexed by BOTH endpoints' hosts: kill_host at
         # 1,000 peers must not scan every connection ever opened
@@ -244,10 +298,17 @@ class SimNetwork:
                 f"no simulated listener at {endpoint}"
             )
         spec = self.link(src_host, endpoint[0])
-        # connection setup charges ONE one-way latency in virtual time (the
-        # SYN leg; the accept fires immediately after, and the first data
-        # frame pays the src->dst latency again on delivery)
-        await asyncio.sleep(spec.latency_s)
+        # connection setup charges the full handshake in virtual time: the
+        # SYN leg (src->dst latency) plus the SYN-ACK leg (dst->src) —
+        # ``open_connection`` returning before the SYN-ACK would make the
+        # RPC client's connect timing (the free RTT probe telemetry/links.py
+        # feeds on) read HALF the real round trip, and a simulator model
+        # fitted from that telemetry would come out twice as fast as the
+        # network it mimics. The accept fires once the handshake wait
+        # completes; the first data frame pays the src->dst latency again
+        # on delivery.
+        reverse = self.link(endpoint[0], src_host)
+        await asyncio.sleep(spec.latency_s + reverse.latency_s)
         if listener.closed:  # raced a shutdown during the handshake
             raise ConnectionRefusedError(
                 f"simulated listener at {endpoint} closed during connect"
@@ -303,13 +364,20 @@ class SimNetwork:
             self.stats["loss_drops"] += 1
             loop.call_at(now + spec.latency_s, conn.reset)
             return
-        # serialized uplink: one transmission at a time per source host
-        start = max(now, self._uplink_busy_until.get(src, 0.0))
+        # serialized uplink: one transmission at a time per source host —
+        # except sub-MTU control frames, which interleave (see
+        # _SMALL_FRAME_BYTES above) and do not extend the busy window
+        small = len(payload) <= _SMALL_FRAME_BYTES
+        start = (
+            now if small
+            else max(now, self._uplink_busy_until.get(src, 0.0))
+        )
         if spec.bandwidth_bps > 0.0:
             done = start + len(payload) / spec.bandwidth_bps
         else:
             done = start
-        self._uplink_busy_until[src] = done
+        if not small:
+            self._uplink_busy_until[src] = done
         arrival = done + spec.latency_s + delay_extra
         if spec.jitter_s > 0.0:
             arrival += self.rng.uniform(0.0, spec.jitter_s)

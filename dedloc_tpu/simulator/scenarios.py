@@ -35,19 +35,16 @@ from dedloc_tpu.core.timeutils import get_dht_time
 from dedloc_tpu.simulator.engine import SIM_EPOCH, SimEngine
 from dedloc_tpu.simulator.network import LinkSpec, SimNetwork
 from dedloc_tpu.simulator.swarm import SimSwarm
+from dedloc_tpu.telemetry.links import endpoint_key
 from dedloc_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
 
-def percentile(values: List[float], q: float) -> float:
-    """Nearest-rank percentile (deterministic, no interpolation surprises
-    across numpy versions); 0.0 on empty input."""
-    if not values:
-        return 0.0
-    ordered = sorted(values)
-    rank = min(len(ordered) - 1, max(0, int(round(q * (len(ordered) - 1)))))
-    return ordered[rank]
+# the one shared nearest-rank percentile (utils/stats.py) — the twin
+# fitter computes the identical statistic from dumped logs, and the two
+# must never drift
+from dedloc_tpu.utils.stats import percentile  # noqa: F401 (re-export)
 
 
 def _span_durations(swarm: SimSwarm, name: str,
@@ -330,6 +327,457 @@ async def phase_catalog(run: ScenarioRun) -> None:
     }
 
 
+# --------------------------------------------------- averaging workload
+#
+# The synthetic averaging-round traffic generator: real matchmaking over
+# the simulated transport, then real chunked wire exchanges between group
+# members — scatter chunks serialized per destination, reduced-chunk
+# gather replies pipelined behind them (the PR 3 wire shape) — emitting
+# the PRODUCTION telemetry schema (mm.form_group / avg.round /
+# allreduce.link / link.stats / step.record / opt.overlap_ledger). It is
+# both a sizing scenario in its own right (round-wall numbers for a
+# hypothetical network) and the workload the telemetry-fitted digital
+# twin (dedloc_tpu/twin) replays: the same driver runs the SOURCE
+# scenario and the twin's prediction, so twin fidelity measures the
+# quality of the telemetry -> model fit, not a modeling shortcut.
+
+
+def apply_link_overrides(network: SimNetwork, hosts: List[str],
+                         overrides) -> int:
+    """Apply spec-level per-directed-link overrides (the ``links`` spec
+    key): ``[{"src": host|"*", "dst": host|"*", latency_s?, bandwidth_bps?,
+    loss?, jitter_s?}, ...]``. ``"*"`` spans every host; omitted fields
+    inherit the network DEFAULT link (not the LinkSpec defaults). Returns
+    how many directed links were configured."""
+    count = 0
+    base = network.default_link
+    for raw in overrides or []:
+        raw = dict(raw)
+        src = str(raw.pop("src", "*"))
+        dst = str(raw.pop("dst", "*"))
+        spec = LinkSpec(
+            latency_s=float(raw.get("latency_s", base.latency_s)),
+            bandwidth_bps=float(raw.get("bandwidth_bps", base.bandwidth_bps)),
+            loss=float(raw.get("loss", base.loss)),
+            jitter_s=float(raw.get("jitter_s", base.jitter_s)),
+        )
+        for s in (hosts if src == "*" else [src]):
+            for d in (hosts if dst == "*" else [dst]):
+                if s != d:
+                    network.set_link(s, d, spec)
+                    count += 1
+    return count
+
+
+def _compute_for(spec: Dict[str, Any], peer) -> float:
+    """Per-peer fwd+bwd seconds per boundary. ``compute_s`` is a float (a
+    homogeneous swarm, optionally skewed deterministically per peer via
+    ``compute_skew``) or a ``{label: seconds}`` map (a twin replay's fitted
+    per-peer compute)."""
+    compute = spec.get("compute_s", 0.05)
+    if isinstance(compute, dict):
+        values = [float(v) for v in compute.values()] or [0.05]
+        return float(compute.get(peer.label, sum(values) / len(values)))
+    return float(compute) * (
+        1.0 + float(spec.get("compute_skew", 0.0)) * (peer.index % 4)
+    )
+
+
+async def run_averaging_workload(swarm: SimSwarm,
+                                 spec: Dict[str, Any]) -> Dict[str, Any]:
+    """Drive ``avg_rounds`` averaging rounds over ``swarm`` and return the
+    measured report section. Spec keys (all optional)::
+
+        avg_rounds: 4          # rounds to run
+        group_size: 8          # matchmaking target
+        span_bytes: 98304      # wire payload per DIRECTED link per round
+        chunk_bytes: 24576     # scatter/gather chunk size
+        boundaries: 2          # accumulation boundaries per round
+        samples_per_boundary: 16
+        compute_s: 0.05        # float (+compute_skew) or {label: seconds}
+        overlap: false         # accumulate DURING the round vs before it
+        window_s: 5.0          # averaging_expiration for matchmaking
+        rpc_timeout_s: 120.0
+        restore_bytes: 0       # >0: one sharded catalog restore at the end
+        restore_providers: 4
+        fetch_parallelism: 4
+
+    Every member's exchange opens an ``avg.round`` span, feeds the link
+    estimator per scatter chunk, and emits one ``allreduce.link`` event
+    per remote hop — the event-log schema production peers write, so the
+    twin fitter (and --topology/--steps) consume the dump unchanged."""
+    rounds = int(spec.get("avg_rounds", 4))
+    group_size = int(spec.get("group_size", 8))
+    span_bytes = max(1024, int(spec.get("span_bytes", 98304)))
+    chunk_bytes = max(1024, int(spec.get("chunk_bytes", 24576)))
+    boundaries = max(1, int(spec.get("boundaries", 2)))
+    samples_per_boundary = int(spec.get("samples_per_boundary", 16))
+    overlap = bool(spec.get("overlap", False))
+    window = float(spec.get("window_s", 5.0))
+    rpc_timeout = float(spec.get("rpc_timeout_s", 120.0))
+    prefix = str(spec.get("prefix", "twinexp"))
+    participants = swarm.alive_peers()
+    if len(participants) < 2:
+        raise ValueError("averaging workload needs >= 2 live peers")
+
+    async def _part(_peer, _args):
+        return {"ok": True}
+
+    async def _reduced(_peer, args):
+        # the reduced-chunk gather reply: a chunk-sized payload riding the
+        # server's uplink back to the requester (the pipelined gather leg)
+        return {"data": b"\x00" * int(args["size"])}
+
+    for peer in participants:
+        if peer.matchmaking is None or (
+            peer.matchmaking.target_group_size != group_size
+        ):
+            peer.attach_matchmaking(
+                prefix, bandwidth=100.0, target_group_size=group_size,
+                averaging_expiration=window,
+            )
+        peer.node.server.register("avg.part", _part)
+        peer.node.server.register("avg.get_reduced", _reduced)
+        # endpoint self-identification, same as production logs: lets any
+        # consumer (twin fitter, --topology) resolve link dst -> label
+        peer.telemetry.event(
+            "peer.endpoint", endpoint=endpoint_key(peer.endpoint)
+        )
+        # the run's CONFIG, recorded like a production role logs its
+        # flags: a twin fitted from these logs reads the workload shape
+        # exactly instead of inferring it (every peer carries a copy so
+        # any log subset suffices)
+        peer.telemetry.event(
+            "run.config", window_s=window, group_size=group_size,
+            span_bytes=span_bytes, chunk_bytes=chunk_bytes,
+            boundaries=boundaries,
+            samples_per_boundary=samples_per_boundary, overlap=overlap,
+            # the wire payloads above are raw bytes: a twin fitted from
+            # this run knows its compression baseline instead of assuming
+            compression=str(spec.get("compression", "none")),
+        )
+
+    loop = asyncio.get_event_loop()
+    link_acc: Dict[Any, Dict[str, float]] = {}  # (src, dst_host) -> sums
+    member_walls: List[float] = []  # every member's wall, every round
+    round_walls: List[float] = []  # per-round slowest member (the ledger)
+    per_peer_walls: Dict[str, List[float]] = {}
+    ledger = {"hidden": 0.0, "exposed": 0.0}
+    groups_formed = 0
+    exchange_failures = 0
+
+    async def member_exchange(peer, others, round_id) -> Optional[float]:
+        """One member's wire work for one round. Returns the member's
+        exchange wall in virtual seconds, or None when a link failed."""
+        nonlocal exchange_failures
+        tele = peer.telemetry
+        # walls on the peer telemetry's own clock (virtual under the sim
+        # engine) — the report's round walls and the dumped avg.round
+        # spans a fitter reads must agree
+        t0 = tele.clock()
+
+        async def one_link(endpoint) -> None:
+            acc = {"sent_bytes": 0.0, "recv_bytes": 0.0, "chunks_sent": 0.0,
+                   "chunks_recv": 0.0, "send_s": 0.0, "wait_s": 0.0,
+                   "max_chunk_s": 0.0}
+            gathers = []
+
+            async def gather_chunk(c: int, size: int) -> None:
+                g0 = loop.time()
+                reply = await peer.node.client.call(
+                    endpoint, "avg.get_reduced",
+                    {"round_id": round_id, "chunk": c, "size": size},
+                    timeout=rpc_timeout,
+                )
+                dt = loop.time() - g0
+                acc["recv_bytes"] += len(reply["data"])
+                acc["chunks_recv"] += 1
+                acc["wait_s"] += dt
+                acc["max_chunk_s"] = max(acc["max_chunk_s"], dt)
+
+            try:
+                for c, off in enumerate(range(0, span_bytes, chunk_bytes)):
+                    size = min(chunk_bytes, span_bytes - off)
+                    s0 = loop.time()
+                    await peer.node.client.call(
+                        endpoint, "avg.part",
+                        {"round_id": round_id, "sender": peer.label,
+                         "chunk": c, "data": b"\x00" * size},
+                        timeout=rpc_timeout,
+                    )
+                    dt = max(loop.time() - s0, 1e-9)
+                    # the persistent estimator eats the scatter timing, the
+                    # same seam production allreduce feeds
+                    tele.links().observe_transfer(endpoint, size, dt)
+                    acc["sent_bytes"] += size
+                    acc["chunks_sent"] += 1
+                    acc["send_s"] += dt
+                    acc["max_chunk_s"] = max(acc["max_chunk_s"], dt)
+                    # the reduced chunk streams back while later chunks are
+                    # still being scattered — the pipelined gather
+                    gathers.append(
+                        asyncio.ensure_future(gather_chunk(c, size))
+                    )
+                await asyncio.gather(*gathers)
+            finally:
+                # a scatter failure leaves gather tasks in flight: cancel
+                # and DRAIN them, or their connection-reset exceptions land
+                # as "never retrieved" warnings on the loop
+                for g in gathers:
+                    g.cancel()
+                if gathers:
+                    await asyncio.gather(*gathers, return_exceptions=True)
+                key = (peer.label, str(endpoint[0]))
+                swarm_acc = link_acc.setdefault(
+                    key, {"bytes": 0.0, "send_s": 0.0}
+                )
+                swarm_acc["bytes"] += acc["sent_bytes"]
+                swarm_acc["send_s"] += acc["send_s"]
+                tele.event(
+                    "allreduce.link", round_id=round_id,
+                    dst=endpoint_key(endpoint),
+                    sent_bytes=int(acc["sent_bytes"]),
+                    recv_bytes=int(acc["recv_bytes"]),
+                    chunks_sent=int(acc["chunks_sent"]),
+                    chunks_recv=int(acc["chunks_recv"]),
+                    send_s=round(acc["send_s"], 6),
+                    wait_s=round(acc["wait_s"], 6),
+                    max_chunk_s=round(acc["max_chunk_s"], 6),
+                )
+
+        with tele.span(
+            "avg.round", trace_seed=round_id, round_id=round_id,
+            group_size=len(others) + 1,
+        ) as ctx:
+            results = await asyncio.gather(
+                *(one_link(ep) for _label, ep in others),
+                return_exceptions=True,
+            )
+            failures = [r for r in results if isinstance(r, Exception)]
+            ctx["ok"] = not failures
+            if failures:
+                ctx["error"] = type(failures[0]).__name__
+                exchange_failures += len(failures)
+                return None
+        wall = tele.clock() - t0
+        member_walls.append(wall)
+        per_peer_walls.setdefault(peer.label, []).append(wall)
+        return wall
+
+    # first/last boundary stamps: the samples/sec window. Defined over the
+    # boundary RECORDS (not the phase's whole duration) so a fitter reading
+    # only the dumped step.record events computes the identical rate.
+    stamps = {"first": None, "last": None, "samples": 0.0}
+
+    async def accumulate(peer, r: int) -> None:
+        tele = peer.telemetry
+        compute = _compute_for(spec, peer)
+        for b in range(boundaries):
+            await asyncio.sleep(compute)
+            tele.histogram("step.phase.fwd_bwd").observe(compute)
+            tele.event(
+                "step.record", step=r * boundaries + b,
+                dur_s=round(compute, 6), samples=samples_per_boundary,
+                phases={"fwd_bwd": round(compute, 6)}, untimed_s=0.0,
+            )
+            now = get_dht_time()
+            if stamps["first"] is None:
+                stamps["first"] = now
+            stamps["last"] = now
+            stamps["samples"] += samples_per_boundary
+
+    async def one_round(r: int) -> None:
+        nonlocal groups_formed
+        round_id = f"avground-{r:04d}"
+        alive = [p for p in participants if p.alive]
+        acc_task = asyncio.gather(*(accumulate(p, r) for p in alive))
+        if not overlap:
+            # synchronous boundary: accumulate, THEN average on the
+            # critical path
+            await acc_task
+
+        async def form(peer):
+            try:
+                return peer, await peer.matchmaking.form_group(round_id)
+            except Exception:  # noqa: BLE001 — counted via group=None
+                return peer, None
+
+        formed = await asyncio.gather(*(form(p) for p in alive))
+        exchanges = []
+        seen_nonces = set()
+        for peer, group in formed:
+            if group is None or len(group.members) < 2:
+                continue
+            if group.nonce not in seen_nonces:
+                seen_nonces.add(group.nonce)
+                groups_formed += 1
+            if peer.endpoint is None:
+                continue
+            my_ep = tuple(peer.endpoint)
+            others = [
+                (m.peer_id, tuple(m.endpoint)) for m in group.members
+                if m.endpoint is not None and tuple(m.endpoint) != my_ep
+            ]
+            if not others:
+                continue
+            exchanges.append(member_exchange(peer, others, round_id))
+        walls = [w for w in await asyncio.gather(*exchanges)
+                 if w is not None]
+        if overlap:
+            await acc_task
+        if walls:
+            round_wall = max(walls)
+            round_walls.append(round_wall)
+            accum_wall = max(
+                _compute_for(spec, p) * boundaries for p in alive
+            )
+            hidden = min(round_wall, accum_wall) if overlap else 0.0
+            exposed = round_wall - hidden
+            ledger["hidden"] += hidden
+            ledger["exposed"] += exposed
+            alive[0].telemetry.event(
+                "opt.overlap_ledger", round_id=round_id,
+                mode="overlap" if overlap else "sync",
+                hidden_s=round(hidden, 6), exposed_s=round(exposed, 6),
+                efficiency=round(hidden / max(round_wall, 1e-9), 4),
+            )
+        # let leader-entry expirations clear so rounds stay disjoint
+        await asyncio.sleep(window + 1.0)
+
+    t_start = get_dht_time()
+    for r in range(rounds):
+        await one_round(r)
+    report: Dict[str, Any] = {
+        "rounds": rounds,
+        "group_size": group_size,
+        "span_bytes": span_bytes,
+        "chunk_bytes": chunk_bytes,
+        "boundaries": boundaries,
+        "samples_per_boundary": samples_per_boundary,
+        "overlap": overlap,
+        "groups_formed": groups_formed,
+        "exchange_failures": exchange_failures,
+    }
+    duration = max(get_dht_time() - t_start, 1e-9)
+    report["duration_s"] = round(duration, 3)
+    # percentiles over every MEMBER's wall of every round (not per-round
+    # maxima): ~group_size more samples, so the estimate does not swing on
+    # which group happened to draw the slowest peer in a short run — and
+    # the twin fitter computes the identical statistic from avg.round spans
+    report["round_wall_p50_s"] = round(percentile(member_walls, 0.50), 4)
+    report["round_wall_p95_s"] = round(percentile(member_walls, 0.95), 4)
+    report["per_peer_round_wall_s"] = {
+        label: round(sum(walls) / len(walls), 4)
+        for label, walls in sorted(per_peer_walls.items())
+    }
+    durs = _span_durations(swarm, "mm.form_group")
+    report["formation_p50_s"] = round(percentile(durs, 0.50), 4)
+    report["formation_p95_s"] = round(percentile(durs, 0.95), 4)
+    # swarm-wide samples/sec over the first->last boundary-record window —
+    # the SAME definition the twin fitter computes from dumped step.record
+    # events, so observed and predicted rates are like-for-like. A window
+    # under 1 ms (a 1-round x 1-boundary workload whose stamps differ only
+    # by engine tie-break epsilons) is below the stamp resolution: report
+    # None, not a ~1e8 garbage rate a sweep would happily rank by.
+    if (
+        stamps["first"] is not None
+        and stamps["last"] - stamps["first"] > 1e-3
+    ):
+        report["samples_per_sec"] = round(
+            stamps["samples"] / (stamps["last"] - stamps["first"]), 3
+        )
+    else:
+        report["samples_per_sec"] = None
+    total_ledger = ledger["hidden"] + ledger["exposed"]
+    report["overlap_efficiency"] = (
+        round(ledger["hidden"] / total_ledger, 4) if total_ledger else None
+    )
+    # observed per-link wire rate, worst first — the ranking a fitted twin
+    # must reproduce (src/dst are host labels)
+    worst = sorted(
+        (
+            (src, dst, acc["bytes"] / max(acc["send_s"], 1e-9))
+            for (src, dst), acc in link_acc.items()
+            if acc["bytes"] > 0
+        ),
+        key=lambda item: item[2],
+    )
+    report["worst_links"] = [
+        [src, dst, round(bps, 1)] for src, dst, bps in worst[:10]
+    ]
+    if int(spec.get("restore_bytes", 0)) > 0:
+        report["restore"] = await _workload_restore(swarm, spec, prefix)
+    return report
+
+
+async def _workload_restore(swarm: SimSwarm, spec: Dict[str, Any],
+                            prefix: str) -> Dict[str, Any]:
+    """One sharded catalog restore over the workload's links: providers
+    serve a synthetic checkpoint of ``restore_bytes``, a non-provider
+    restores it with ``fetch_parallelism`` — the fetch-sizing leg of a
+    twin replay (and the source of ``ckpt.provider_goodput`` telemetry)."""
+    from dedloc_tpu.checkpointing.catalog import parse_announcements
+    from dedloc_tpu.checkpointing.fetcher import sharded_restore
+    from dedloc_tpu.checkpointing.catalog import catalog_key
+
+    restore_bytes = int(spec.get("restore_bytes", 0))
+    total_size = max(256, restore_bytes // 4)  # fp32 elements
+    shard_size = max(64, total_size // 8)
+    alive = swarm.alive_peers()
+    n_providers = max(1, min(int(spec.get("restore_providers", 4)),
+                             len(alive) - 1))
+    providers = alive[:n_providers]
+    loop = asyncio.get_event_loop()
+    for peer in providers:
+        peer.serve_checkpoint(
+            step=1, total_size=total_size, shard_size=shard_size
+        )
+        await peer.announce_checkpoint(f"{prefix}-restore")
+    reader = alive[n_providers]
+    entry = await reader.node.get(
+        catalog_key(f"{prefix}-restore").encode(), latest=True
+    )
+    items = (
+        [(sk, v.value) for sk, v in entry.value.items()]
+        if entry is not None and hasattr(entry.value, "items")
+        else []
+    )
+    announcements = parse_announcements(items)
+    t0 = loop.time()
+    ok = False
+    stats: Dict[str, Any] = {}
+    # the ckpt.restore span production's load_state_from_peers opens
+    # around its sharded path — the fitter reads restore shape from it
+    with reader.telemetry.span(
+        "ckpt.restore", mode="sharded", bytes=total_size * 4
+    ) as ctx:
+        try:
+            await sharded_restore(
+                reader.node.client, announcements,
+                parallelism=int(spec.get("fetch_parallelism", 4)),
+                telemetry_registry=reader.telemetry, stats=stats,
+            )
+            ok = True
+        except Exception as e:  # noqa: BLE001 — reported, not raised
+            logger.warning(f"workload restore failed: {e!r}")
+        ctx["ok"] = ok
+        ctx["providers"] = int(stats.get("providers", 0))
+    return {
+        "ok": ok,
+        "restore_s": round(loop.time() - t0, 4),
+        "bytes": total_size * 4,
+        "providers": n_providers,
+        "providers_used": int(stats.get("providers", 0)),
+        "fetch_parallelism": int(spec.get("fetch_parallelism", 4)),
+    }
+
+
+async def phase_averaging(run: ScenarioRun) -> None:
+    run.report["averaging"] = await run_averaging_workload(
+        run.swarm, run.spec
+    )
+
+
 # -------------------------------------------------------------- scenarios
 
 
@@ -358,12 +806,56 @@ async def _scenario_mixed(run: ScenarioRun) -> None:
     await phase_catalog(run)
 
 
+async def _scenario_averaging(run: ScenarioRun) -> None:
+    """The digital-twin source scenario: spawn, apply the spec's per-link
+    overrides (the known asymmetric network a twin must rediscover from
+    telemetry alone), then run averaging rounds to a round-wall report."""
+    await phase_spawn(run)
+    run.report["link_overrides"] = apply_link_overrides(
+        run.network,
+        [p.host for p in run.swarm.peers],
+        run.spec.get("links"),
+    )
+    await phase_averaging(run)
+
+
 SCENARIOS: Dict[str, Callable] = {
     "dht_churn": _scenario_dht_churn,
     "matchmaking": _scenario_matchmaking,
     "catalog": _scenario_catalog,
     "mixed": _scenario_mixed,
+    "averaging": _scenario_averaging,
+    # resolved specially by run_scenario: replays a fitted TwinModel
+    # (dedloc_tpu/twin) instead of building a swarm from spec numbers
+    "twin_replay": None,
 }
+
+
+def _run_twin_replay(spec: Dict[str, Any],
+                     out_dir: Optional[str] = None) -> Dict[str, Any]:
+    """The ``twin_replay`` scenario: spec carries a fitted TwinModel
+    (``twin`` inline, or ``twin_path`` pointing at its JSON) plus optional
+    workload ``overrides`` — the swarm, links and workload all come from
+    the MODEL, not from scenario numbers."""
+    from dedloc_tpu.twin.fit import TwinModel
+    from dedloc_tpu.twin.replay import replay_twin
+
+    if spec.get("twin") is not None:
+        model = TwinModel.from_dict(spec["twin"])
+    elif spec.get("twin_path"):
+        model = TwinModel.load(str(spec["twin_path"]))
+    else:
+        raise ValueError(
+            "twin_replay needs 'twin' (inline model dict) or 'twin_path'"
+        )
+    report = replay_twin(
+        model,
+        overrides=spec.get("overrides"),
+        seed=int(spec.get("seed", 0)),
+        out_dir=out_dir,
+    )
+    report["scenario"] = "twin_replay"
+    return report
 
 
 def run_scenario(
@@ -378,6 +870,8 @@ def run_scenario(
         raise ValueError(
             f"unknown scenario {name!r}; pick one of {sorted(SCENARIOS)}"
         )
+    if name == "twin_replay":
+        return _run_twin_replay(spec, out_dir=out_dir)
     run = ScenarioRun(spec)
     t0 = time.perf_counter()
     try:

@@ -80,7 +80,22 @@ class SimPeer:
         self.index = index
         self.label = label
         self.host = host
-        self.telemetry = Telemetry(peer=label, max_events=self.MAX_EVENTS)
+        # link_top_k raised to the LinkTable's own bound: the 8-link cap
+        # protects the signed metrics-bus SNAPSHOT, but a simulated peer
+        # dumps to JSONL post-run, and a twin fitted from that dump needs
+        # every link's RTT, not just the 8 busiest.
+        # clock: the VIRTUAL clock, not the fake-clock-aware monotonic —
+        # span durations then measure only MODELED time, not the real
+        # Python seconds the host happened to spend executing the
+        # scenario. That noise was ±5-15% of a sub-second round wall and
+        # varied run to run, which both blurred the determinism story and
+        # put a floor under digital-twin fidelity. (Outside the engine
+        # get_dht_time is the wall clock — the interactive-debug case
+        # keeps real timings.)
+        self.telemetry = Telemetry(
+            peer=label, max_events=self.MAX_EVENTS, link_top_k=64,
+            clock=get_dht_time,
+        )
         self.node: Optional[DHTNode] = None
         self.matchmaking: Optional[Matchmaking] = None
         self.alive = False

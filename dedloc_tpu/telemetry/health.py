@@ -35,6 +35,9 @@ from typing import Dict, List, Optional
 _PEER_COUNTERS = {
     "rpc_failures": "rpc.client.failures",
     "rpc_calls": "rpc.client.calls",
+    # connection-death count: with rpc_calls it gives the per-peer loss
+    # rate a telemetry-fitted simulator model (dedloc_tpu/twin) reads
+    "conns_lost": "rpc.conns_lost",
     "rounds_attempted": "mm.rounds_attempted",
     "rounds_formed": "mm.rounds_formed",
     "rounds_aborted": "mm.rounds_aborted",
@@ -89,6 +92,12 @@ def _peer_entry(m, current_step: int) -> Dict:
     mfu = t.get("step.mfu")
     if mfu is not None:
         entry["mfu"] = float(mfu)
+    # mean verified checkpoint-fetch goodput this peer measured against its
+    # providers — an uplink-bandwidth signal for the twin fitter that
+    # exists even on fleets that never ran a single averaging round
+    provider_goodput = t.get("ckpt.provider_goodput.mean")
+    if provider_goodput is not None:
+        entry["provider_goodput_bps"] = float(provider_goodput)
     # overlap ledger (collaborative optimizer): cumulative hidden/exposed
     # averaging seconds → lifetime overlap efficiency for this peer
     hidden = float(t.get("opt.overlap_hidden_s", 0.0))

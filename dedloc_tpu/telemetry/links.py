@@ -64,18 +64,36 @@ class LinkStats:
     WINDOW = 64
 
     __slots__ = (
-        "dst", "rtt_s", "rtt_samples", "goodput_bps", "bytes", "transfers",
-        "_recent_s", "last_seq",
+        "dst", "rtt_s", "rtt_samples", "rtt_jitter_s", "rtt_min_s",
+        "goodput_bps", "bytes", "transfers", "_recent_s", "_recent_bps",
+        "last_seq",
     )
 
     def __init__(self, dst: str) -> None:
         self.dst = dst
         self.rtt_s: Optional[float] = None
         self.rtt_samples = 0
+        # EWMA of |sample - estimate|: the link's delay variation, the
+        # jitter the simulator's LinkSpec.jitter_s models (a digital twin
+        # fitted from this table needs spread, not just the center)
+        self.rtt_jitter_s = 0.0
+        # fastest sample ever: connect timings ride the caller's event
+        # loop, so every sample carries scheduling noise ON TOP of the
+        # wire round trip — the minimum is the cleanest base-RTT estimate
+        # (the one a fitted simulator model should pay per hop)
+        self.rtt_min_s: Optional[float] = None
         self.goodput_bps: Optional[float] = None
         self.bytes = 0
         self.transfers = 0
         self._recent_s: Deque[float] = deque(maxlen=self.WINDOW)
+        # recent per-transfer rates: ``peak_bps`` (the best of them) is the
+        # least-CONTENDED observation — transfers time wall while the
+        # sender's uplink is shared, so the EWMA reads effective goodput
+        # under load, while the peak approaches raw link bandwidth. A
+        # fitted simulator model must use the peak: it re-simulates the
+        # contention itself, and seeding it with contended goodput would
+        # charge the queueing twice.
+        self._recent_bps: Deque[float] = deque(maxlen=self.WINDOW)
         # observation sequence number (table-wide): eviction order when the
         # table is full — the STALEST link yields, never the newest
         self.last_seq = 0
@@ -96,8 +114,14 @@ class LinkStats:
         }
         if self.rtt_s is not None:
             out["rtt_s"] = round(self.rtt_s, 6)
+            if self.rtt_min_s is not None:
+                out["rtt_min_s"] = round(self.rtt_min_s, 6)
+            if self.rtt_samples >= 2:
+                out["rtt_jitter_s"] = round(self.rtt_jitter_s, 6)
         if self.goodput_bps is not None:
             out["goodput_bps"] = round(self.goodput_bps, 1)
+        if self._recent_bps:
+            out["peak_bps"] = round(max(self._recent_bps), 1)
         if self._recent_s:
             out["chunk_p50_s"] = round(self.chunk_percentile(0.50), 6)
             out["chunk_max_s"] = round(max(self._recent_s), 6)
@@ -145,7 +169,16 @@ class LinkTable:
             if link.rtt_s is None:
                 link.rtt_s = float(rtt_s)
             else:
+                # deviation against the PRE-update estimate: the first
+                # sample contributes zero jitter by construction
+                link.rtt_jitter_s += self.alpha * (
+                    abs(float(rtt_s) - link.rtt_s) - link.rtt_jitter_s
+                )
                 link.rtt_s += self.alpha * (float(rtt_s) - link.rtt_s)
+            link.rtt_min_s = (
+                float(rtt_s) if link.rtt_min_s is None
+                else min(link.rtt_min_s, float(rtt_s))
+            )
             link.rtt_samples += 1
 
     def observe_transfer(self, dst, nbytes: int, seconds: float) -> None:
@@ -168,6 +201,7 @@ class LinkTable:
             link.bytes += int(nbytes)
             link.transfers += 1
             link._recent_s.append(seconds)
+            link._recent_bps.append(sample_bps)
 
     # ---------------------------------------------------------- publication
 

@@ -240,3 +240,99 @@ def test_bench_gate_unknown_metric_warns_and_passes(tmp_path, capsys):
     }))
     assert bench_gate.main([str(novel)]) == 0
     assert "no comparable baseline" in capsys.readouterr().out
+
+
+# ------------------------------------------- MULTICHIP trajectory gate
+# (ISSUE 11 satellite: the MULTICHIP_r*.json rounds were in-tree but
+# unguarded. Contract-tested against the COMMITTED artifacts and
+# synthetic records — never runs a bench. The gated value is the swarm
+# samples/sec derived from the tail's timestamped "global step N applied
+# (group=G, samples~S)" optimizer lines.)
+
+
+def _multichip_path(r):
+    return os.path.join(_REPO, f"MULTICHIP_r{r:02d}.json")
+
+
+def _multichip_tail(rates, n_steps=6, samples=48, start="2026-08-02 10:00"):
+    """A synthetic driver tail: applied-step lines at 1/rates steps/sec."""
+    import datetime
+
+    t = datetime.datetime.strptime(start, "%Y-%m-%d %H:%M")
+    lines = []
+    for i in range(n_steps):
+        stamp = t.strftime("%Y-%m-%d %H:%M:%S.%f")[:-3]
+        lines.append(
+            f"[{stamp}][INFO][dedloc_tpu.collaborative.optimizer] "
+            f"global step {i + 1} applied (group=2, samples~{samples})"
+        )
+        t += datetime.timedelta(seconds=1.0 / rates)
+    return "\n".join(lines) + "\n"
+
+
+def test_multichip_trajectory_parses_and_gates_clean(capsys):
+    """The committed MULTICHIP rounds gate: rounds whose tail carries
+    applied steps parse to a swarm samples/sec under a device-count-scoped
+    metric name; the best round gates clean against the default set."""
+    import glob as globmod
+
+    rounds = sorted(globmod.glob(os.path.join(_REPO, "MULTICHIP_r*.json")))
+    assert rounds, "MULTICHIP_r*.json artifacts missing from the tree"
+    loaded = [(p, bench_gate.load_bench(p)) for p in rounds]
+    capsys.readouterr()  # drain the expected early-round warnings
+    parseable = [pr for pr in loaded if pr[1] is not None]
+    assert parseable, "no MULTICHIP round carries applied-step lines"
+    for _p, rec in parseable:
+        assert rec["metric"] == "multichip8_swarm_samples_per_sec"
+        assert rec["value"] > 0 and rec["steps"] >= 2
+    best = max(parseable, key=lambda pr: pr[1]["value"])[0]
+    assert bench_gate.main([best]) == 0
+
+
+def test_multichip_rounds_without_steps_are_absent_not_fatal(capsys):
+    """Early rounds whose tail captured only the jax banner (r01-r03)
+    skip with a warning — the missing-round rule, not an error."""
+    record = bench_gate.load_bench(_multichip_path(1))
+    assert record is None
+    assert "applied-step" in capsys.readouterr().err
+    # ...and their presence in the baseline set never wedges a gate
+    fresh = bench_gate.load_bench(_multichip_path(5))
+    assert fresh is not None
+    assert bench_gate.main(
+        [_multichip_path(5)] + [_multichip_path(r) for r in (1, 4, 5)]
+    ) == 0
+
+
+def test_multichip_gate_catches_synthetic_regression(tmp_path, capsys):
+    """A fresh multichip round 50% slower than the committed trajectory
+    exits 1; a failed/skipped fresh round is exit 2 (not gateable); a
+    different device count gates its own (empty) trajectory and passes as
+    the bootstrap case."""
+    best = max(
+        (bench_gate.load_bench(_multichip_path(r)) for r in (4, 5)),
+        key=lambda rec: rec["value"] if rec else 0.0,
+    )
+    capsys.readouterr()
+    slow_rate = best["value"] / 48 / 2.0  # steps/sec at half throughput
+    slow = tmp_path / "slow_multichip.json"
+    slow.write_text(json.dumps({
+        "n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+        "tail": _multichip_tail(slow_rate),
+    }))
+    assert bench_gate.main([str(slow)]) == 1
+    assert "GATE FAILED" in capsys.readouterr().out
+
+    failed = tmp_path / "failed_multichip.json"
+    failed.write_text(json.dumps({
+        "n_devices": 8, "rc": 1, "ok": False, "skipped": False,
+        "tail": _multichip_tail(10.0),
+    }))
+    assert bench_gate.main([str(failed)]) == 2
+
+    other_devices = tmp_path / "multichip4.json"
+    other_devices.write_text(json.dumps({
+        "n_devices": 4, "rc": 0, "ok": True, "skipped": False,
+        "tail": _multichip_tail(1.0),
+    }))
+    assert bench_gate.main([str(other_devices)]) == 0
+    assert "no comparable baseline" in capsys.readouterr().out

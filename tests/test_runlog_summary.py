@@ -252,6 +252,128 @@ def test_topology_degrades_to_allreduce_link_rows(tmp_path, capsys):
     assert "2.0KB/s" in out  # 1000 B / 0.5 s
 
 
+# ----------------------------- --json machine-readable mode (ISSUE 11)
+# (one JSON document per view, so the twin pipeline and future tooling
+# consume summaries without screen-scraping; smoke over BOTH schemas —
+# per-peer event logs and coordinator metrics JSONL)
+
+
+def test_json_mode_health_view(tmp_path, capsys):
+    events = [
+        {"t": 100.0, "peer": "peerA", "event": "avg.round", "dur_s": 0.5,
+         "round_id": "step1", "ok": True, "group_size": 2},
+        {"t": 100.3, "peer": "peerA", "event": "state_sync.retry",
+         "attempt": 1},
+        {"t": 101.0, "peer": "peerB", "event": "fault.applied",
+         "point": "averager.state_get", "action": "truncate"},
+        {"t": 102.0, "peer": "joiner", "event": "ckpt.restore",
+         "dur_s": 1.25, "mode": "sharded", "ok": True, "shards": 8,
+         "bytes": 1048576, "providers": 3},
+    ]
+    runlog_summary.main(
+        ["--json", "--health", _write_events(tmp_path, events)]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["view"] == "health"
+    assert doc["per_peer"]["peerA"]["retries"] == 1
+    assert doc["per_peer"]["peerB"]["faults"] == 1
+    assert doc["rounds"][0]["round_id"] == "step1"
+    assert doc["checkpoint"]["restores"][0]["mode"] == "sharded"
+
+
+def test_json_mode_steps_view_both_schemas(tmp_path, capsys):
+    events = [
+        {"t": 1.0, "peer": "p0", "event": "step.record", "step": 0,
+         "dur_s": 0.6, "samples": 16, "untimed_s": 0.0,
+         "phases": {"fwd_bwd": 0.5, "data_wait": 0.1}},
+        {"t": 2.0, "peer": "p1", "event": "step.record", "step": 0,
+         "dur_s": 2.1, "samples": 16, "untimed_s": 0.0,
+         "phases": {"fwd_bwd": 0.5, "data_wait": 1.6}},
+    ]
+    runlog_summary.main(["--json", "--steps", _write_events(tmp_path, events)])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["view"] == "steps"
+    assert doc["per_peer"]["p1"]["dominant"] == "data_wait"
+    assert doc["skew"][0]["phase"] == "data_wait"
+    assert doc["skew"][0]["peer"] == "p1"
+
+    # coordinator schema: swarm_health.peers[].phases
+    coord = {"t": 1.0, "swarm_health": {"current_step": 3, "peers": [
+        {"peer": "fast", "step": 3, "phases": {"fwd_bwd": 0.6}},
+        {"peer": "slow", "step": 3,
+         "phases": {"fwd_bwd": 0.6, "data_wait": 1.8}},
+    ]}}
+    p = tmp_path / "coord.jsonl"
+    p.write_text(json.dumps(coord) + "\n")
+    runlog_summary.main(["--json", "--steps", str(p)])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["per_peer"]["slow"]["dominant"] == "data_wait"
+
+
+def test_json_mode_topology_and_trace_views(tmp_path, capsys):
+    span_id = "a" * 16
+    rows = [
+        {"t": 1.0, "peer": "p0", "event": "peer.endpoint",
+         "endpoint": "127.0.0.1:1"},
+        {"t": 2.0, "peer": "p0", "event": "avg.round", "dur_s": 0.4,
+         "round_id": "step3", "ok": True, "trace": "t" * 16,
+         "span": span_id},
+        {"t": 2.1, "peer": "p1", "event": "mm.join.serve", "dur_s": 0.1,
+         "round_id": "step3", "ok": True, "trace": "t" * 16,
+         "span": "b" * 16, "parent": "c" * 16, "caller": "ghost"},
+        {"t": 3.0, "peer": "p1", "event": "link.stats",
+         "dst": "127.0.0.1:1", "rtt_s": 0.02, "goodput_bps": 1000.0,
+         "bytes": 64, "transfers": 2},
+    ]
+    path = _write_events(tmp_path, rows)
+    runlog_summary.main(["--json", "--topology", path])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["view"] == "topology"
+    assert doc["worst_link"] == {"src": "p1", "dst": "p0"}
+    assert doc["links"][0]["goodput_bps"] == 1000.0
+
+    runlog_summary.main(["--json", "--trace", "step3", path])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["view"] == "trace"
+    assert doc["peers"] == ["p0", "p1"]
+    # the orphaned span is reported, never dropped
+    assert doc["orphans"][0]["parent"] == "c" * 16
+
+
+def test_json_mode_trainlog_view(tmp_path, capsys):
+    # 8 rows: the percentile block skips the first 5 (warmup), matching
+    # the text view
+    rows = [
+        {"wall_s": 10.0 * (i + 1), "step": i + 1, "loss": 11.0 - i,
+         "boundary_ms": 120.0 - i}
+        for i in range(8)
+    ]
+    runlog_summary.main(["--json", _write(tmp_path, rows)])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["view"] == "train_log"
+    assert doc["steps"][-1]["step"] == 8
+    assert doc["total_steps"] == 8
+    assert "boundary_ms" in doc["phase_percentiles_ms"]
+
+
+def test_json_and_text_modes_agree_on_the_same_data(tmp_path, capsys):
+    """The JSON document and the rendered table are two faces of one
+    computation — the dominant phase named in the text must be the one in
+    the document."""
+    events = [
+        {"t": 1.0, "peer": "p0", "event": "step.record", "step": 0,
+         "dur_s": 1.0, "samples": 8, "untimed_s": 0.0,
+         "phases": {"avg_wire": 0.9, "fwd_bwd": 0.1}},
+    ]
+    path = _write_events(tmp_path, events)
+    runlog_summary.main(["--steps", path])
+    text = capsys.readouterr().out
+    runlog_summary.main(["--json", "--steps", path])
+    doc = json.loads(capsys.readouterr().out)
+    assert "dominant avg_wire" in text
+    assert doc["per_peer"]["p0"]["dominant"] == "avg_wire"
+
+
 def test_topology_accepts_coordinator_folded_record(tmp_path, capsys):
     """--topology also renders a coordinator metrics JSONL whose
     swarm_health.topology already folded the per-peer link views."""

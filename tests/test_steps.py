@@ -500,7 +500,10 @@ def test_attribution_data_stall_vs_slow_wire_two_peers(tmp_path, capsys):
     tx = lamb(0.05, weight_decay=0.0)
     kwargs = dict(
         target_batch_size=64,
-        averaging_expiration=2.5,
+        # the window must comfortably cover the injected 2.4s data stall,
+        # or the healthy peer forms a singleton round before the stalled
+        # one arrives and the slow-wire fault never sees an avg.* RPC
+        averaging_expiration=5.0,
         averaging_timeout=20.0,
         min_refresh_period=0.1,
         default_refresh_period=0.3,
@@ -528,9 +531,21 @@ def test_attribution_data_stall_vs_slow_wire_two_peers(tmp_path, capsys):
         ),
     )
     errors = []
+    # the stalled peer must get its step-0 progress record onto the bus
+    # BEFORE the fast peer's first round launches: with no visible partner
+    # the optimizer grants only the short near-step grace, the fast peer
+    # rounds as a singleton, steps, exits — and the slow-wire fault never
+    # meets an avg.* RPC. The fast peer therefore starts only after the
+    # stalled peer's first boundary (fully stalled — its dominance sample)
+    # has been reported.
+    stall_visible = threading.Event()
 
     def peer(name, stall_s):
         try:
+            if name == "wire":
+                assert stall_visible.wait(timeout=60), (
+                    "stalled peer never published its first boundary"
+                )
             opt, rec = opts[name], recorders[name]
             params = {"w": jnp.array([[0.5], [0.5]])}
             state = TrainState.create(params, tx)
@@ -560,13 +575,20 @@ def test_attribution_data_stall_vs_slow_wire_two_peers(tmp_path, capsys):
                     )
                     if srec is not None:
                         srec.attrs["stepped"] = stepped
+                if name == "stall":
+                    stall_visible.set()  # first stalled boundary reported
             assert stepped, f"{name} never performed a global step"
         except Exception as e:  # noqa: BLE001
             errors.append((name, e))
 
     with schedule:
         threads = [
-            threading.Thread(target=peer, args=("stall", 1.2), daemon=True),
+            # 2.4s stall vs 0.06s wire delays: the dominance margin is
+            # ~40x and the phase-coverage margin ~2x even when the
+            # single-core tier-1 box schedules these threads unfairly
+            # (memory/tier1-box-facts.md — was 1.2s, which flaked under
+            # full-suite contention)
+            threading.Thread(target=peer, args=("stall", 2.4), daemon=True),
             threading.Thread(target=peer, args=("wire", 0.01), daemon=True),
         ]
         try:

@@ -30,20 +30,79 @@ same spirit as the t1 gate:
 
 Accepted file shapes: a driver record (``{"n": 5, "parsed": {...}}``,
 the BENCH_r*.json layout), the bare bench line (``{"metric": ...,
-"value": ...}``), or a file whose last ``{``-prefixed line is that bench
-line (raw ``python bench.py`` output).
+"value": ...}``), a file whose last ``{``-prefixed line is that bench
+line (raw ``python bench.py`` output), or a MULTICHIP driver record
+(``{"n_devices": 8, "ok": true, "tail": "...log..."}``): the swarm
+throughput is derived from the tail's timestamped ``global step N applied
+(group=G, samples~S)`` optimizer lines, under the metric name
+``multichip<n>_swarm_samples_per_sec`` so different device counts never
+gate against each other. MULTICHIP rounds whose tail carries no applied
+steps (an early driver that captured only the jax banner) are simply
+absent from the baseline set — the same missing-round rule as a sparse
+glob.
 """
 from __future__ import annotations
 
 import argparse
+import datetime
 import glob
 import json
 import os
+import re
 import sys
 from typing import Dict, List, Optional, Tuple
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE_GLOB = os.path.join(REPO_ROOT, "BENCH_r*.json")
+MULTICHIP_BASELINE_GLOB = os.path.join(REPO_ROOT, "MULTICHIP_r*.json")
+
+# "[2026-08-01 21:43:54.504][INFO][dedloc_tpu.collaborative.optimizer]
+#  global step 189 applied (group=1, samples~48)"
+_APPLIED_RE = re.compile(
+    r"\[(\d{4}-\d{2}-\d{2} \d{2}:\d{2}:\d{2}\.\d{3})\]"
+    r".*global step (\d+) applied \(group=(\d+), samples~(\d+)\)"
+)
+
+
+def parse_multichip(record: Dict, path: str) -> Optional[Dict]:
+    """Synthesize a bench record from a MULTICHIP driver record's log
+    tail, or None (with a stderr warning) when the round is not gateable:
+    failed/skipped runs, and tails without at least two applied-step lines
+    (no rate is derivable from a single timestamp)."""
+    if not record.get("ok") or record.get("skipped") or record.get("rc"):
+        print(f"warning: skipping {path}: multichip round not ok/complete",
+              file=sys.stderr)
+        return None
+    matches = _APPLIED_RE.findall(str(record.get("tail", "")))
+    if len(matches) < 2:
+        print(
+            f"warning: skipping {path}: multichip tail has "
+            f"{len(matches)} applied-step line(s); need >= 2 for a rate",
+            file=sys.stderr,
+        )
+        return None
+
+    def stamp(raw: str) -> datetime.datetime:
+        return datetime.datetime.strptime(raw, "%Y-%m-%d %H:%M:%S.%f")
+
+    t_first = stamp(matches[0][0])
+    t_last = stamp(matches[-1][0])
+    span = (t_last - t_first).total_seconds()
+    if span <= 0:
+        print(f"warning: skipping {path}: applied-step timestamps do not "
+              "advance", file=sys.stderr)
+        return None
+    # samples attributed to the interval: everything AFTER the first
+    # applied line (the first stamp opens the measurement window)
+    samples = sum(int(s) for _t, _step, _g, s in matches[1:])
+    n_devices = int(record.get("n_devices", 0))
+    return {
+        "metric": f"multichip{n_devices}_swarm_samples_per_sec",
+        "value": round(samples / span, 3),
+        "unit": "samples/sec",
+        "steps": len(matches),
+        "n_devices": n_devices,
+    }
 
 
 def load_bench(path: str) -> Optional[Dict]:
@@ -71,6 +130,13 @@ def load_bench(path: str) -> Optional[Dict]:
                 break
     if isinstance(record, dict) and isinstance(record.get("parsed"), dict):
         record = record["parsed"]  # BENCH_r*.json driver layout
+    if (
+        isinstance(record, dict)
+        and "metric" not in record
+        and "tail" in record
+        and "n_devices" in record
+    ):
+        return parse_multichip(record, path)  # MULTICHIP_r*.json layout
     if (
         not isinstance(record, dict)
         or "metric" not in record
@@ -160,7 +226,8 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "baselines", nargs="*",
-        help=f"baseline bench JSONs (default: {DEFAULT_BASELINE_GLOB})",
+        help=f"baseline bench JSONs (default: {DEFAULT_BASELINE_GLOB} "
+             f"+ {MULTICHIP_BASELINE_GLOB})",
     )
     parser.add_argument(
         "--tolerance", type=float, default=0.03,
@@ -173,7 +240,12 @@ def main(argv=None) -> int:
         print(f"error: fresh bench file {args.fresh} is not a bench record",
               file=sys.stderr)
         return 2
-    paths = args.baselines or sorted(glob.glob(DEFAULT_BASELINE_GLOB))
+    # both trajectories ride the default baseline set: the fresh record's
+    # metric name filters out the incomparable one
+    paths = args.baselines or sorted(
+        glob.glob(DEFAULT_BASELINE_GLOB)
+        + glob.glob(MULTICHIP_BASELINE_GLOB)
+    )
     baselines = [r for r in (load_bench(p) for p in paths) if r is not None]
     text, code = gate(fresh, baselines, tolerance=args.tolerance)
     print(text)

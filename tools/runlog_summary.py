@@ -7,6 +7,12 @@ Usage:
     python tools/runlog_summary.py --trace ROUND_ID events.jsonl [...]
     python tools/runlog_summary.py --topology events.jsonl [...]
     python tools/runlog_summary.py --steps events.jsonl [...]
+    python tools/runlog_summary.py --twin events.jsonl [...]
+
+Any view also accepts ``--json``: one machine-readable JSON document on
+stdout (schema: the ``*_data`` builders below, each tagged with a
+``view`` field) instead of the rendered tables — the twin pipeline and
+future tooling consume summaries without screen-scraping.
 
 Default mode prints a markdown `| global step | wall (min) | loss |` table at
 the given checkpoints (default: a log-spaced selection plus the final step)
@@ -35,6 +41,14 @@ accepts a coordinator metrics JSONL whose ``swarm_health.topology`` record
 already folded the per-peer views): per-link RTT/goodput estimates ranked
 worst-first, low-RTT clique candidates, and fat/thin peers — the input the
 hierarchical matchmaker reads (ROADMAP item 1).
+
+``--twin`` fits a digital twin (``dedloc_tpu/twin``) from the event logs,
+replays the recorded workload over it in virtual time, and renders the
+FIDELITY report — twin-predicted vs observed round wall / formation /
+samples-per-sec / overlap efficiency, per peer and swarm-wide, plus the
+worst-link ranking agreement and the fit-coverage summary. With ``--json``
+the machine-readable fidelity document is printed, so twin drift is itself
+monitorable.
 
 ``--steps`` renders the step-phase flight recorder's view (per-step
 ``step.record`` / ``step.phase`` events from ``telemetry/steps.py``, or a
@@ -164,14 +178,130 @@ _RETRY_EVENTS = ("state_sync.retry",)
 _ROUND_EVENTS = ("avg.round", "mm.form_group", "allreduce.round")
 
 
+def _health_per_peer(rows):
+    """Per-peer fault/retry counters — the --health table's data."""
+    per_peer = {}
+    for r in rows:
+        peer = r.get("peer", "?")
+        stats = per_peer.setdefault(
+            peer,
+            {"faults": 0, "retries": 0, "checksum": 0, "rpc_fail": 0,
+             "join_fail": 0, "dropped": 0, "events": 0},
+        )
+        stats["events"] += 1
+        event = r["event"]
+        if event in _FAULT_EVENTS:
+            stats["faults"] += 1
+        elif event in _RETRY_EVENTS:
+            stats["retries"] += 1
+        elif event == "state_sync.checksum_failure":
+            stats["checksum"] += 1
+        elif event == "rpc.client.failure":
+            stats["rpc_fail"] += 1
+        elif event == "mm.join_failed":
+            stats["join_fail"] += 1
+        elif event == "opt.grads_dropped":
+            stats["dropped"] += 1
+    return per_peer
+
+
+def _health_rounds(rows):
+    rounds = [r for r in rows if r["event"] == "avg.round"]
+    if not rounds:  # peers that never reached a full round: show what ran
+        rounds = [r for r in rows if r["event"] in _ROUND_EVENTS]
+    return rounds
+
+
+def _wire_per_peer(rows):
+    """Per-peer pipelined-allreduce aggregates (reduce- vs wire-bound)."""
+    wire_rounds = [r for r in rows if r["event"] == "allreduce.round"
+                   and ("reduce_s" in r or "gather_wait_s" in r)]
+    per_peer_wire = {}
+    for r in wire_rounds:
+        acc = per_peer_wire.setdefault(
+            r.get("peer", "?"),
+            {"rounds": 0, "dur": 0.0, "reduce": 0.0, "gather": 0.0,
+             "chunks": 0},
+        )
+        acc["rounds"] += 1
+        acc["dur"] += float(r.get("dur_s", 0.0))
+        acc["reduce"] += float(r.get("reduce_s", 0.0))
+        acc["gather"] += float(r.get("gather_wait_s", 0.0))
+        acc["chunks"] += int(r.get("chunks", 0))
+    return per_peer_wire
+
+
+def _ckpt_failures(rows):
+    failures = {}
+    for r in rows:
+        if r["event"] in ("ckpt.shard_fetch_failed",
+                          "ckpt.shard_verify_failure"):
+            acc = failures.setdefault(r.get("peer", "?"),
+                                      {"fetch": 0, "verify": 0})
+            if r["event"] == "ckpt.shard_fetch_failed":
+                acc["fetch"] += 1
+            else:
+                acc["verify"] += 1
+    return failures
+
+
+def health_data(rows):
+    """The --health view as one JSON-able document."""
+    if not rows:
+        sys.exit("no telemetry events found (is --telemetry.enabled set?)")
+    t0 = min(r.get("t", 0.0) for r in rows)
+
+    def simplify(r, *keys):
+        out = {"t": round(r.get("t", 0.0) - t0, 3),
+               "peer": r.get("peer", "?"), "event": r["event"]}
+        for key in keys:
+            if r.get(key) is not None:
+                out[key] = r[key]
+        return out
+
+    return {
+        "view": "health",
+        "events": len(rows),
+        "rounds": [
+            simplify(r, "round_id", "dur_s", "ok", "group_size")
+            for r in _health_rounds(rows)
+        ],
+        "faults": [
+            simplify(r, "point", "method", "action")
+            for r in rows if r["event"] in _FAULT_EVENTS
+        ],
+        "per_peer": _health_per_peer(rows),
+        "wire": {
+            peer: {
+                "rounds": a["rounds"],
+                "dur_mean_s": round(a["dur"] / a["rounds"], 6),
+                "reduce_mean_s": round(a["reduce"] / a["rounds"], 6),
+                "gather_wait_mean_s": round(a["gather"] / a["rounds"], 6),
+                "chunks_mean": round(a["chunks"] / a["rounds"], 2),
+            }
+            for peer, a in _wire_per_peer(rows).items()
+        },
+        "checkpoint": {
+            "manifests": [
+                simplify(r, "step", "shards", "bytes")
+                for r in rows if r["event"] == "ckpt.manifest_written"
+            ],
+            "restores": [
+                simplify(r, "mode", "ok", "dur_s", "shards", "bytes",
+                         "providers")
+                for r in rows if r["event"] == "ckpt.restore"
+            ],
+            "shard_failures": _ckpt_failures(rows),
+        },
+    }
+
+
 def print_health(rows):
     if not rows:
         sys.exit("no telemetry events found (is --telemetry.enabled set?)")
     t0 = min(r.get("t", 0.0) for r in rows)
 
-    rounds = [r for r in rows if r["event"] == "avg.round"]
-    if not rounds:  # peers that never reached a full round: show what ran
-        rounds = [r for r in rows if r["event"] in _ROUND_EVENTS]
+    rounds = _health_rounds(rows)
     print("round timeline:")
     if not rounds:
         print("  (no rounds recorded)")
@@ -197,49 +327,15 @@ def print_health(rows):
                 f"{where} action={r.get('action', '?')}"
             )
 
-    per_peer = {}
-    for r in rows:
-        peer = r.get("peer", "?")
-        stats = per_peer.setdefault(
-            peer,
-            {"faults": 0, "retries": 0, "checksum": 0, "rpc_fail": 0,
-             "join_fail": 0, "dropped": 0, "events": 0},
-        )
-        stats["events"] += 1
-        event = r["event"]
-        if event in _FAULT_EVENTS:
-            stats["faults"] += 1
-        elif event in _RETRY_EVENTS:
-            stats["retries"] += 1
-        elif event == "state_sync.checksum_failure":
-            stats["checksum"] += 1
-        elif event == "rpc.client.failure":
-            stats["rpc_fail"] += 1
-        elif event == "mm.join_failed":
-            stats["join_fail"] += 1
-        elif event == "opt.grads_dropped":
-            stats["dropped"] += 1
+    per_peer = _health_per_peer(rows)
 
     # wire-path attribution (pipelined all-reduce, docs/observability.md):
     # every hosting member's allreduce.round span carries reduce_s (CPU time
     # in the eager per-chunk reduce) and gather_wait_s (wall from gather
     # launch to the last reduced chunk landing) — a slow round whose
     # gather_wait dwarfs reduce_s is wire-bound, the reverse is CPU-bound
-    wire_rounds = [r for r in rows if r["event"] == "allreduce.round"
-                   and ("reduce_s" in r or "gather_wait_s" in r)]
-    if wire_rounds:
-        per_peer_wire = {}
-        for r in wire_rounds:
-            acc = per_peer_wire.setdefault(
-                r.get("peer", "?"),
-                {"rounds": 0, "dur": 0.0, "reduce": 0.0, "gather": 0.0,
-                 "chunks": 0},
-            )
-            acc["rounds"] += 1
-            acc["dur"] += float(r.get("dur_s", 0.0))
-            acc["reduce"] += float(r.get("reduce_s", 0.0))
-            acc["gather"] += float(r.get("gather_wait_s", 0.0))
-            acc["chunks"] += int(r.get("chunks", 0))
+    per_peer_wire = _wire_per_peer(rows)
+    if per_peer_wire:
         print("\nwire path (mean per all-reduce round):")
         print("| peer | rounds | dur | reduce | gather wait | chunks |")
         print("|---|---|---|---|---|---|")
@@ -258,16 +354,7 @@ def print_health(rows):
     # shard fetch/verify failure counts the retry ladder absorbed
     manifests = [r for r in rows if r["event"] == "ckpt.manifest_written"]
     restores = [r for r in rows if r["event"] == "ckpt.restore"]
-    ckpt_failures = {}
-    for r in rows:
-        if r["event"] in ("ckpt.shard_fetch_failed",
-                          "ckpt.shard_verify_failure"):
-            acc = ckpt_failures.setdefault(r.get("peer", "?"),
-                                           {"fetch": 0, "verify": 0})
-            if r["event"] == "ckpt.shard_fetch_failed":
-                acc["fetch"] += 1
-            else:
-                acc["verify"] += 1
+    ckpt_failures = _ckpt_failures(rows)
     if manifests or restores or ckpt_failures:
         print("\ncheckpoint / restore:")
         for r in manifests:
@@ -351,6 +438,49 @@ def select_trace(rows, round_key):
             or _round_matches(r.get("round_id", ""), round_key)
         ], traces
     return matched, traces
+
+
+def trace_data(rows, round_key):
+    """The --trace view as one JSON-able document."""
+    trace_rows, traces = select_trace(rows, round_key)
+    if not trace_rows:
+        sys.exit(
+            f"no events for round {round_key!r} (is --telemetry.enabled "
+            "set, and are these the right event logs?)"
+        )
+    ep_map = _endpoint_map(rows)
+    spans = {r["span"]: r for r in trace_rows if r.get("span")}
+    t0 = min(r.get("t", 0.0) for r in trace_rows)
+    hops = [r for r in trace_rows if r.get("event") == "allreduce.link"]
+    doc = {
+        "view": "trace",
+        "round": round_key,
+        "traces": sorted(traces),
+        "peers": sorted({r.get("peer", "?") for r in trace_rows}),
+        "events": [
+            {**{k: v for k, v in r.items() if k != "t"},
+             "t": round(r.get("t", 0.0) - t0, 6)}
+            for r in sorted(trace_rows, key=lambda r: r.get("t", 0.0))
+        ],
+        "orphans": [
+            {"peer": r.get("peer", "?"), "event": r.get("event", "?"),
+             "parent": r["parent"], "caller": r.get("caller")}
+            for r in trace_rows
+            if r.get("parent") and r["parent"] not in spans
+        ],
+    }
+    if hops:
+        worst = max(hops, key=lambda r: float(r.get("wait_s", 0.0)))
+        doc["critical_path"] = {
+            "peer": worst.get("peer", "?"),
+            "dst": _fmt_dst(worst.get("dst"), ep_map),
+            "wait_s": float(worst.get("wait_s", 0.0)),
+            "reduce_total_s": sum(
+                float(r.get("reduce_s", 0.0)) for r in trace_rows
+                if r.get("event") == "allreduce.round"
+            ),
+        }
+    return doc
 
 
 def print_trace(rows, round_key):
@@ -475,7 +605,8 @@ def _links_from_events(rows):
         out = []
         for (src, dst), r in sorted(latest.items()):
             link = {"src": src, "dst": dst}
-            for key in ("rtt_s", "goodput_bps", "bytes", "transfers",
+            for key in ("rtt_s", "rtt_min_s", "rtt_jitter_s",
+                        "goodput_bps", "peak_bps", "bytes", "transfers",
                         "chunk_p50_s", "chunk_max_s"):
                 if key in r:
                     link[key] = float(r[key])
@@ -530,7 +661,10 @@ def _fmt_rate(bps):
     return f"{bps:.0f}B/s"
 
 
-def print_topology(all_rows):
+def _collect_topology(all_rows):
+    """Link records (with ``dst_label`` resolved) from per-peer events or
+    the newest folded coordinator topology record — the data both the
+    rendered matrix and the --json document are built from."""
     # a coordinator metrics JSONL already carries the folded record: use the
     # newest; otherwise fold per-peer link.stats events here
     folded = [
@@ -548,13 +682,97 @@ def print_topology(all_rows):
                 ep_map.setdefault(str(endpoint), label)
     else:
         links = _links_from_events(event_rows)
+    for link in links:
+        link["dst_label"] = ep_map.get(
+            str(link.get("dst")), str(link.get("dst"))
+        )
+    return links
+
+
+def _clique_groups(links):
+    """(median rtt, clique candidate groups): peers whose pairwise RTT sits
+    well under the swarm median are same-datacenter material — the
+    hierarchical matchmaker's local-reduction groups (ROADMAP item 1)."""
+    rtts = sorted(
+        l["rtt_s"] for l in links if l.get("rtt_s") is not None
+    )
+    if len(rtts) < 2:
+        return None, []
+    median_rtt = rtts[len(rtts) // 2]
+    fast_pairs = [
+        (l["src"], l["dst_label"]) for l in links
+        if l.get("rtt_s") is not None and l["rtt_s"] <= 0.5 * median_rtt
+    ]
+    if not fast_pairs:
+        return median_rtt, []
+    # union-find over low-RTT pairs
+    parent = {}
+
+    def find(x):
+        parent.setdefault(x, x)
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a, b in fast_pairs:
+        parent[find(a)] = find(b)
+    cliques = {}
+    for node in parent:
+        cliques.setdefault(find(node), set()).add(node)
+    return median_rtt, sorted(
+        sorted(c) for c in cliques.values() if len(c) >= 2
+    )
+
+
+def _fat_thin(links):
+    """(per-peer mean inbound goodput, fat peers, thin peers): the
+    degenerate-strategy signal (a few fat peers become de-facto parameter
+    servers for thin client-mode volunteers)."""
+    inbound = {}
+    for l in links:
+        if l.get("goodput_bps") is not None:
+            inbound.setdefault(l["dst_label"], []).append(l["goodput_bps"])
+    if len(inbound) < 2:
+        return {}, [], []
+    means = {p: sum(v) / len(v) for p, v in inbound.items()}
+    ordered = sorted(means.values())
+    median = ordered[len(ordered) // 2]
+    fat = sorted(p for p, m in means.items() if m >= 2.0 * median)
+    thin = sorted(p for p, m in means.items() if m <= 0.5 * median)
+    return means, fat, thin
+
+
+def topology_data(all_rows):
+    """The --topology view as one JSON-able document."""
+    links = _collect_topology(all_rows)
     if not links:
         sys.exit(
             "no link telemetry found (links appear after the first "
             "snapshot/close flush — is --telemetry.enabled set?)"
         )
-    for link in links:
-        link["dst_label"] = ep_map.get(str(link.get("dst")), str(link.get("dst")))
+    ranked = sorted(links, key=_link_sort_key)
+    median_rtt, cliques = _clique_groups(links)
+    _means, fat, thin = _fat_thin(links)
+    worst = ranked[0]
+    return {
+        "view": "topology",
+        "links": ranked,
+        "worst_link": {"src": worst["src"], "dst": worst["dst_label"]},
+        "median_rtt_s": median_rtt,
+        "cliques": cliques,
+        "fat_peers": fat,
+        "thin_peers": thin,
+    }
+
+
+def print_topology(all_rows):
+    links = _collect_topology(all_rows)
+    if not links:
+        sys.exit(
+            "no link telemetry found (links appear after the first "
+            "snapshot/close flush — is --telemetry.enabled set?)"
+        )
 
     print("link matrix (src -> dst: rtt / goodput):")
     srcs = sorted({l["src"] for l in links})
@@ -595,62 +813,22 @@ def print_topology(all_rows):
         f"chunk p50 {worst.get('chunk_p50_s', 0.0):.3f}s)"
     )
 
-    # clique candidates: peers whose pairwise RTT sits well under the swarm
-    # median are same-datacenter material — the hierarchical matchmaker's
-    # local-reduction groups (ROADMAP item 1)
-    rtts = sorted(
-        l["rtt_s"] for l in links if l.get("rtt_s") is not None
-    )
-    if len(rtts) >= 2:
-        median_rtt = rtts[len(rtts) // 2]
-        fast_pairs = [
-            (l["src"], l["dst_label"]) for l in links
-            if l.get("rtt_s") is not None and l["rtt_s"] <= 0.5 * median_rtt
-        ]
-        if fast_pairs:
-            # union-find over low-RTT pairs
-            parent = {}
+    median_rtt, groups = _clique_groups(links)
+    if groups:
+        print(
+            "\nclique candidates (pairwise RTT <= 0.5x median "
+            f"{median_rtt * 1e3:.1f}ms):"
+        )
+        for group in groups:
+            print(f"  {group}")
 
-            def find(x):
-                parent.setdefault(x, x)
-                while parent[x] != x:
-                    parent[x] = parent[parent[x]]
-                    x = parent[x]
-                return x
-
-            for a, b in fast_pairs:
-                parent[find(a)] = find(b)
-            cliques = {}
-            for node in parent:
-                cliques.setdefault(find(node), set()).add(node)
-            groups = [sorted(c) for c in cliques.values() if len(c) >= 2]
-            if groups:
-                print(
-                    "\nclique candidates (pairwise RTT <= 0.5x median "
-                    f"{median_rtt * 1e3:.1f}ms):"
-                )
-                for group in sorted(groups):
-                    print(f"  {group}")
-
-    # fat/thin peers: aggregate goodput of the links INTO each peer — the
-    # degenerate-strategy signal (a few fat peers become de-facto parameter
-    # servers for thin client-mode volunteers)
-    inbound = {}
-    for l in links:
-        if l.get("goodput_bps") is not None:
-            inbound.setdefault(l["dst_label"], []).append(l["goodput_bps"])
-    if len(inbound) >= 2:
-        means = {p: sum(v) / len(v) for p, v in inbound.items()}
-        ordered = sorted(means.values())
-        median = ordered[len(ordered) // 2]
-        fat = [p for p, m in means.items() if m >= 2.0 * median]
-        thin = [p for p, m in means.items() if m <= 0.5 * median]
-        if fat or thin:
-            print("\nfat/thin peers (mean inbound-link goodput vs median):")
-            for p in sorted(fat):
-                print(f"  fat:  {p} ({_fmt_rate(means[p])})")
-            for p in sorted(thin):
-                print(f"  thin: {p} ({_fmt_rate(means[p])})")
+    means, fat, thin = _fat_thin(links)
+    if fat or thin:
+        print("\nfat/thin peers (mean inbound-link goodput vs median):")
+        for p in fat:
+            print(f"  fat:  {p} ({_fmt_rate(means[p])})")
+        for p in thin:
+            print(f"  thin: {p} ({_fmt_rate(means[p])})")
 
 
 # ----------------------------------------------------------------- steps view
@@ -762,6 +940,83 @@ def _steps_from_health(all_rows):
     return per_peer
 
 
+def _phase_skews(per_peer):
+    """[(ratio, phase, worst peer, worst s, median-of-others s)] most
+    skewed first — the cross-peer "who is slow and WHY" ranking."""
+    all_names = sorted({
+        n for acc in per_peer.values() for n in acc["phases"]
+    })
+    skews = []
+    for name in all_names:
+        vals = {
+            peer: acc["phases"][name]
+            for peer, acc in per_peer.items() if name in acc["phases"]
+        }
+        if len(vals) < 2:
+            continue
+        worst_peer = max(vals, key=vals.get)
+        worst = vals[worst_peer]
+        if worst <= 0:
+            continue
+        # median of the OTHER peers: the worst offender must not drag
+        # the reference point toward itself (with 2 peers an inclusive
+        # median IS the worst value and every ratio reads 1.0x)
+        rest = sorted(v for p, v in vals.items() if p != worst_peer)
+        median = rest[len(rest) // 2]
+        ratio = worst / median if median > 0 else float("inf")
+        skews.append((ratio, name, worst_peer, worst, median))
+    skews.sort(key=lambda s: -s[0])
+    return skews
+
+
+def steps_data(all_rows):
+    """The --steps view as one JSON-able document."""
+    event_rows = [r for r in all_rows if "event" in r]
+    per_peer = _steps_from_events(event_rows)
+    if not per_peer:
+        per_peer = _steps_from_health(all_rows)
+    if not per_peer:
+        sys.exit(
+            "no step-phase telemetry found (step.record events appear when "
+            "--telemetry.enabled is set on a trainer; a coordinator metrics "
+            "JSONL needs swarm_health.peers[].phases)"
+        )
+    ledgers = [
+        r for r in event_rows if r.get("event") == "opt.overlap_ledger"
+    ]
+    hidden = sum(float(r.get("hidden_s", 0.0)) for r in ledgers)
+    exposed = sum(float(r.get("exposed_s", 0.0)) for r in ledgers)
+    doc = {
+        "view": "steps",
+        "per_peer": {
+            peer: {
+                **acc,
+                "dominant": (
+                    max(acc["phases"], key=acc["phases"].get)
+                    if acc["phases"] else None
+                ),
+            }
+            for peer, acc in per_peer.items()
+        },
+        "skew": [
+            {"phase": name, "peer": peer,
+             "ratio": None if ratio == float("inf") else round(ratio, 3),
+             "worst_s": round(worst, 6), "median_s": round(median, 6)}
+            for ratio, name, peer, worst, median in _phase_skews(per_peer)
+        ],
+        "overlap_ledger": [
+            {k: r.get(k) for k in ("t", "peer", "round_id", "mode",
+                                   "hidden_s", "exposed_s", "efficiency")}
+            for r in sorted(ledgers, key=lambda r: r.get("t", 0.0))
+        ],
+    }
+    if hidden + exposed > 0:
+        doc["overall_overlap_efficiency"] = round(
+            hidden / (hidden + exposed), 4
+        )
+    return doc
+
+
 def _bar(value, full, width=24):
     if not full or full <= 0:
         return ""
@@ -812,29 +1067,7 @@ def print_steps(all_rows):
     # — the cross-peer "who is slow and WHY" ranking (DeDLOC heterogeneous
     # volunteers: per-peer phase skew is the first-order signal)
     if len(per_peer) >= 2:
-        all_names = sorted({
-            n for acc in per_peer.values() for n in acc["phases"]
-        })
-        skews = []
-        for name in all_names:
-            vals = {
-                peer: acc["phases"][name]
-                for peer, acc in per_peer.items() if name in acc["phases"]
-            }
-            if len(vals) < 2:
-                continue
-            worst_peer = max(vals, key=vals.get)
-            worst = vals[worst_peer]
-            if worst <= 0:
-                continue
-            # median of the OTHER peers: the worst offender must not drag
-            # the reference point toward itself (with 2 peers an inclusive
-            # median IS the worst value and every ratio reads 1.0x)
-            rest = sorted(v for p, v in vals.items() if p != worst_peer)
-            median = rest[len(rest) // 2]
-            ratio = worst / median if median > 0 else float("inf")
-            skews.append((ratio, name, worst_peer, worst, median))
-        skews.sort(key=lambda s: -s[0])
+        skews = _phase_skews(per_peer)
         if skews:
             print("\nphase skew across peers (worst vs median, "
                   "most skewed first):")
@@ -878,55 +1111,188 @@ def print_steps(all_rows):
                 print(f"  {peer}: {effs[peer]:.2f}")
 
 
+# ------------------------------------------------------------- twin view
+# (digital-twin fidelity: fit dedloc_tpu/twin from the logs, replay, and
+# report predicted vs observed — imported lazily so every other view
+# stays stdlib-only)
+
+
+def twin_fidelity(all_rows, seed=0):
+    import os
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from dedloc_tpu.twin.fit import fit_twin
+    from dedloc_tpu.twin.replay import fidelity_report
+
+    try:
+        model = fit_twin(all_rows)
+    except ValueError as e:
+        sys.exit(f"cannot fit a twin from these logs: {e}")
+    return model, fidelity_report(model, seed=seed)
+
+
+def print_twin(all_rows, seed=0):
+    model, fid = twin_fidelity(all_rows, seed=seed)
+    for line in model.describe():
+        print(line)
+    workload = {k: v for k, v in model.workload.items() if v is not None}
+    print(f"recorded workload: {json.dumps(workload, sort_keys=True)}")
+
+    print("\ntwin fidelity (predicted vs observed):")
+    print("| metric | observed | predicted | error |")
+    print("|---|---|---|---|")
+    for name, m in fid["metrics"].items():
+        err = (
+            f"{m['error'] * 100.0:+.1f}%" if m.get("error") is not None
+            else "-"
+        )
+        obs = "-" if m["observed"] is None else f"{m['observed']:.4g}"
+        pred = "-" if m["predicted"] is None else f"{m['predicted']:.4g}"
+        print(f"| {name} | {obs} | {pred} | {err} |")
+
+    per_peer = fid.get("per_peer") or {}
+    if per_peer:
+        print("\nper-peer round wall (observed vs predicted), "
+              "worst error first:")
+        print("| peer | observed | predicted | error |")
+        print("|---|---|---|---|")
+        ranked = sorted(
+            per_peer.items(),
+            key=lambda kv: -abs(kv[1].get("error") or 0.0),
+        )
+        for peer, m in ranked[:10]:
+            err = (
+                f"{m['error'] * 100.0:+.1f}%"
+                if m.get("error") is not None else "-"
+            )
+            obs = m.get("observed_round_wall_s")
+            pred = m.get("predicted_round_wall_s")
+            print(
+                f"| {peer} |"
+                f" {'-' if obs is None else f'{obs:.4f}s'} |"
+                f" {'-' if pred is None else f'{pred:.4f}s'} | {err} |"
+            )
+
+    worst = fid.get("worst_links") or {}
+    if worst.get("observed") or worst.get("predicted"):
+        print("\nworst-link ranking:")
+        print(f"  observed : {worst.get('observed')}")
+        print(f"  predicted: {worst.get('predicted')}")
+        if "bottleneck_match" in worst:
+            verdict = "MATCH" if worst["bottleneck_match"] else "MISMATCH"
+            print(
+                f"  bottleneck peer: observed "
+                f"{worst.get('bottleneck_observed')} vs predicted "
+                f"{worst.get('bottleneck_predicted')} — {verdict}"
+            )
+    bound = fid.get("sweep_error_bound")
+    if bound is not None:
+        print(
+            f"\nsweep error bound: ±{bound * 100.0:.1f}% — predictions "
+            "from tools/twin_sweep.py carry this confidence interval"
+        )
+
+
+def trainlog_data(rows, requested):
+    """The default (train_log) view as one JSON-able document."""
+    by_step = {r["step"]: r for r in rows}
+    t0 = rows[0]["wall_s"] - rows[0].get("step_wall_s", 0.0)
+    doc = {
+        "view": "train_log",
+        "steps": [
+            {
+                "step": s,
+                "wall_min": round((by_step[s]["wall_s"] - t0) / 60, 3),
+                "loss": by_step[s]["loss"],
+            }
+            for s in pick_steps(rows, requested)
+        ],
+        "phase_percentiles_ms": {},
+        "total_steps": rows[-1]["step"],
+        "total_wall_min": round((rows[-1]["wall_s"] - t0) / 60, 2),
+    }
+    for key in ("boundary_ms", "data_wait_ms", "allreduce_ms", "seam_ms"):
+        vals = [r[key] for r in rows[5:] if key in r]
+        if vals and isinstance(vals[0], dict):  # seam_ms: per-phase subkeys
+            for sub in sorted({sub for v in vals for sub in v}):
+                p50, p90, p99 = percentiles(
+                    [v[sub] for v in vals if sub in v]
+                )
+                doc["phase_percentiles_ms"][f"{key}.{sub}"] = [p50, p90, p99]
+            continue
+        if vals:
+            doc["phase_percentiles_ms"][key] = list(percentiles(vals))
+    return doc
+
+
 def main(argv):
+    # --json anywhere switches any view to its machine-readable document
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+
+    def emit(doc):
+        print(json.dumps(doc, indent=1, default=str))
+
     if argv and argv[0] == "--health":
         if not argv[1:]:
             sys.exit("usage: runlog_summary.py --health events.jsonl [...]")
-        print_health(load_events(argv[1:]))
+        rows = load_events(argv[1:])
+        emit(health_data(rows)) if as_json else print_health(rows)
         return
     if argv and argv[0] == "--trace":
         if len(argv) < 3:
             sys.exit(
                 "usage: runlog_summary.py --trace ROUND_ID events.jsonl [...]"
             )
-        print_trace(load_events(argv[2:]), argv[1])
+        rows = load_events(argv[2:])
+        if as_json:
+            emit(trace_data(rows, argv[1]))
+        else:
+            print_trace(rows, argv[1])
         return
     if argv and argv[0] == "--topology":
         if not argv[1:]:
             sys.exit("usage: runlog_summary.py --topology events.jsonl [...]")
-        print_topology(load_jsonl_rows(argv[1:]))
+        rows = load_jsonl_rows(argv[1:])
+        emit(topology_data(rows)) if as_json else print_topology(rows)
         return
     if argv and argv[0] == "--steps":
         if not argv[1:]:
             sys.exit("usage: runlog_summary.py --steps events.jsonl [...]")
-        print_steps(load_jsonl_rows(argv[1:]))
+        rows = load_jsonl_rows(argv[1:])
+        emit(steps_data(rows)) if as_json else print_steps(rows)
+        return
+    if argv and argv[0] == "--twin":
+        if not argv[1:]:
+            sys.exit("usage: runlog_summary.py --twin events.jsonl [...]")
+        rows = load_jsonl_rows(argv[1:])
+        if as_json:
+            _model, fid = twin_fidelity(rows)
+            emit(fid)
+        else:
+            print_twin(rows)
         return
     rows = load(argv[0])
     if not rows:
         sys.exit(f"{argv[0]}: no log rows")
     requested = [int(a) for a in argv[1:]]
-    by_step = {r["step"]: r for r in rows}
-    t0 = rows[0]["wall_s"] - rows[0].get("step_wall_s", 0.0)
-
+    # text and --json render from the SAME collector (like every other
+    # view): two copies of the warmup-skip / percentile logic would drift
+    doc = trainlog_data(rows, requested)
+    if as_json:
+        emit(doc)
+        return
     print("| global step | wall (min) | train loss |")
     print("|---|---|---|")
-    for s in pick_steps(rows, requested):
-        r = by_step[s]
-        print(f"| {s} | {(r['wall_s'] - t0) / 60:.1f} | {r['loss']:.3f} |")
-
-    for key in ("boundary_ms", "data_wait_ms", "allreduce_ms", "seam_ms"):
-        vals = [r[key] for r in rows[5:] if key in r]
-        if vals and isinstance(vals[0], dict):  # seam_ms: per-phase subkeys
-            subs = sorted({sub for v in vals for sub in v})
-            for sub in subs:
-                p50, p90, p99 = percentiles([v[sub] for v in vals if sub in v])
-                print(f"{key}.{sub}: p50/p90/p99 = "
-                      f"{p50:.0f}/{p90:.0f}/{p99:.0f} ms")
-            continue
-        p50, p90, p99 = percentiles(vals)
+    for entry in doc["steps"]:
+        print(f"| {entry['step']} | {entry['wall_min']:.1f} |"
+              f" {entry['loss']:.3f} |")
+    for key, (p50, p90, p99) in doc["phase_percentiles_ms"].items():
         print(f"{key}: p50/p90/p99 = {p50:.0f}/{p90:.0f}/{p99:.0f} ms")
-    mins = (rows[-1]["wall_s"] - t0) / 60
-    print(f"total: {rows[-1]['step']} global steps in {mins:.0f} min wall")
+    print(f"total: {doc['total_steps']} global steps in "
+          f"{doc['total_wall_min']:.0f} min wall")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,253 @@
+"""Sweep averaging configurations over a telemetry-fitted digital twin.
+
+Every tuning question the fleet runbook used to answer with a fleet
+experiment — "right ``--averager.chunk_size``? compression on? overlap on?
+bigger matchmaking groups? more fetch parallelism?" — costs a virtual-time
+replay here instead: seconds of wall clock against the TwinModel fitted
+from the run's own telemetry (``dedloc_tpu/twin``), not a week of fleet
+time. The output is a recommended config with its predicted samples/sec
+and a **fidelity-bounded confidence interval**: the twin first replays the
+recorded workload against itself, and the resulting prediction error
+(``sweep_error_bound`` in the fidelity report) brackets every sweep
+prediction — a sweep is only as trustworthy as its twin, and the tool says
+how trustworthy that is.
+
+Usage::
+
+    # fit from event logs (or a coordinator metrics JSONL), then sweep
+    python tools/twin_sweep.py /logs/*.jsonl
+    # keep the fitted model for later / for runlog_summary --twin
+    python tools/twin_sweep.py --fit-out twin.json /logs/*.jsonl
+    # sweep a previously fitted model
+    python tools/twin_sweep.py --model twin.json
+    # narrower grid, machine-readable output
+    python tools/twin_sweep.py --model twin.json --json \
+        --chunk-sizes 32768,131072 --group-sizes 4,8 --overlap both
+
+Grid axes (all optional; see docs/simulator.md "fit a twin"):
+``--chunk-sizes`` (fp32 elements, the ``--averager.chunk_size`` knob),
+``--compressions`` (none/float16/uint8), ``--overlap`` (on/off/both),
+``--group-sizes``, ``--fetch-parallelism`` (only evaluated when the
+recorded workload contained restores). Exits 2 when no model can be
+fitted from the inputs.
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _parse_int_list(raw: str) -> List[int]:
+    return [int(v) for v in raw.split(",") if v.strip()]
+
+
+def _overlap_values(raw: str) -> List[bool]:
+    raw = raw.lower()
+    if raw == "on":
+        return [True]
+    if raw == "off":
+        return [False]
+    if raw == "both":
+        return [False, True]
+    sys.exit(f"--overlap expects on|off|both, got {raw!r}")
+
+
+def _config_label(config: Dict[str, Any]) -> str:
+    parts = [f"chunk={config['chunk_size']}"]
+    parts.append(f"comp={config['compression']}")
+    parts.append(f"group={config['group_size']}")
+    parts.append("overlap" if config["overlap"] else "sync")
+    if "fetch_parallelism" in config:
+        parts.append(f"fetch={config['fetch_parallelism']}")
+    return " ".join(parts)
+
+
+def sweep(model, grid: List[Dict[str, Any]], seed: int = 0,
+          rounds: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Replay every grid config over ``model``; returns result rows sorted
+    best-first by predicted samples/sec. A config whose replay fails is
+    reported with an ``error`` field, never silently dropped."""
+    from dedloc_tpu.twin.replay import replay_twin
+
+    results = []
+    for config in grid:
+        overrides = dict(config)
+        if rounds is not None:
+            overrides["rounds"] = rounds
+        try:
+            report = replay_twin(model, overrides=overrides, seed=seed)
+            results.append({
+                "config": config,
+                "samples_per_sec": report.get("samples_per_sec"),
+                "round_wall_p50_s": report.get("round_wall_p50_s"),
+                "round_wall_p95_s": report.get("round_wall_p95_s"),
+                "overlap_efficiency": report.get("overlap_efficiency"),
+                "restore_s": (report.get("restore") or {}).get("restore_s"),
+                "wall_s": report.get("wall_s"),
+            })
+        except Exception as e:  # noqa: BLE001 — a bad config must not
+            # wedge the sweep; it IS the answer for that config
+            results.append({"config": config, "error": repr(e)})
+    results.sort(
+        key=lambda r: -(r.get("samples_per_sec") or 0.0)
+    )
+    return results
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+    )
+    parser.add_argument("logs", nargs="*",
+                        help="telemetry JSONL files to fit the twin from")
+    parser.add_argument("--model", help="a previously fitted TwinModel JSON")
+    parser.add_argument("--fit-out",
+                        help="write the fitted TwinModel JSON here")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output (one JSON document)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--rounds", type=int, default=3,
+                        help="replay rounds per config (virtual time)")
+    parser.add_argument("--chunk-sizes", default="32768,131072,524288",
+                        help="fp32 elements per chunk (averager knob)")
+    parser.add_argument("--compressions", default="none,float16",
+                        help="wire codecs to sweep (none,float16,uint8)")
+    parser.add_argument("--group-sizes", default="",
+                        help="matchmaking target sizes (default: recorded)")
+    parser.add_argument("--overlap", default="both",
+                        help="overlap averaging: on|off|both")
+    parser.add_argument("--fetch-parallelism", default="",
+                        help="restore fetch parallelism values (only used "
+                             "when the recorded workload had restores)")
+    args = parser.parse_args(argv)
+
+    from dedloc_tpu.twin.fit import TwinModel, fit_twin
+    from dedloc_tpu.twin.replay import fidelity_report
+
+    if args.model:
+        model = TwinModel.load(args.model)
+    elif args.logs:
+        from runlog_summary import load_jsonl_rows
+
+        try:
+            model = fit_twin(load_jsonl_rows(args.logs))
+        except ValueError as e:
+            print(f"error: cannot fit a twin: {e}", file=sys.stderr)
+            return 2
+    else:
+        parser.error("give telemetry logs to fit from, or --model")
+        return 2
+    if args.fit_out:
+        model.save(args.fit_out)
+
+    # the fidelity pass: how much should anyone trust the numbers below?
+    # A None bound means the twin could NOT be validated (no observed
+    # rounds to compare against) — that is "unknown confidence", which
+    # must never render as a zero-width (perfect-confidence) interval.
+    fidelity = fidelity_report(model, seed=args.seed)
+    error_bound = fidelity.get("sweep_error_bound")
+
+    recorded_group = int(model.workload.get("group_size") or 8)
+    group_sizes = (
+        _parse_int_list(args.group_sizes) if args.group_sizes
+        else [recorded_group]
+    )
+    # a group needs at least 2 members and at most the swarm
+    group_sizes = sorted({
+        g for g in group_sizes if 2 <= g <= max(2, len(model.peers))
+    }) or [min(recorded_group, len(model.peers))]
+    fetch_values: List[Optional[int]] = [None]
+    if model.workload.get("restores") and args.fetch_parallelism:
+        fetch_values = _parse_int_list(args.fetch_parallelism)  # type: ignore
+
+    grid: List[Dict[str, Any]] = []
+    for chunk, comp, group, overlap, fetch in itertools.product(
+        _parse_int_list(args.chunk_sizes),
+        [c.strip() for c in args.compressions.split(",") if c.strip()],
+        group_sizes,
+        _overlap_values(args.overlap),
+        fetch_values,
+    ):
+        config: Dict[str, Any] = {
+            "chunk_size": chunk, "compression": comp,
+            "group_size": group, "overlap": overlap,
+        }
+        if fetch is not None:
+            config["fetch_parallelism"] = fetch
+        grid.append(config)
+
+    results = sweep(model, grid, seed=args.seed, rounds=args.rounds)
+    ok_results = [r for r in results if r.get("samples_per_sec")]
+    recommended = ok_results[0] if ok_results else None
+    doc = {
+        "view": "twin_sweep",
+        "peers": len(model.peers),
+        "recorded_workload": model.workload,
+        "fidelity_error_bound": error_bound,
+        "fidelity": fidelity["metrics"],
+        "coverage": model.coverage,
+        "configs": results,
+        "recommended": recommended,
+    }
+    if recommended is not None and error_bound is not None:
+        predicted = recommended["samples_per_sec"]
+        doc["recommended_interval"] = [
+            round(predicted * (1.0 - error_bound), 3),
+            round(predicted * (1.0 + error_bound), 3),
+        ]
+
+    if args.json:
+        print(json.dumps(doc, indent=1, default=str))
+        return 0
+
+    for line in model.describe():
+        print(line)
+    if error_bound is not None:
+        print(f"fidelity error bound: ±{error_bound * 100.0:.1f}% "
+              "(twin replayed against its own recording)")
+    else:
+        print("fidelity error bound: UNKNOWN — the recording carries no "
+              "observed rounds to validate the twin against; treat every "
+              "prediction below as unvalidated")
+    print()
+    print("| config | samples/sec | round p50 | round p95 | restore |")
+    print("|---|---|---|---|---|")
+    for r in results:
+        if "error" in r:
+            print(f"| {_config_label(r['config'])} | FAILED: {r['error']} |"
+                  " - | - | - |")
+            continue
+        restore = (
+            f"{r['restore_s']:.2f}s" if r.get("restore_s") is not None
+            else "-"
+        )
+        print(
+            f"| {_config_label(r['config'])} | {r['samples_per_sec']:.1f} |"
+            f" {r['round_wall_p50_s']:.3f}s | {r['round_wall_p95_s']:.3f}s |"
+            f" {restore} |"
+        )
+    if recommended is not None:
+        print()
+        if "recommended_interval" in doc:
+            lo, hi = doc["recommended_interval"]
+            interval = f" (fidelity-bounded interval [{lo:.1f}, {hi:.1f}])"
+        else:
+            interval = " (UNVALIDATED — no fidelity bound available)"
+        print(
+            f"recommended: {_config_label(recommended['config'])} — "
+            f"predicted {recommended['samples_per_sec']:.1f} samples/sec"
+            + interval
+        )
+    else:
+        print("\nno config produced a prediction — see errors above")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
