@@ -39,6 +39,18 @@ class CoordinatorExtraArguments:
     save_checkpoint_step_interval: int = 5
     upload_interval: float = 0.0  # seconds; 0 disables state pulls
     metrics_log_path: str = "coordinator_metrics.jsonl"
+    # live swarm watchdog (telemetry/watch.py): streams every health fold
+    # through the anomaly detectors; incident open/close transitions land
+    # in their own JSONL (next to the metrics log) and as watch.incident
+    # telemetry events
+    watchdog_enabled: bool = True
+    incident_log_path: str = "coordinator_incidents.jsonl"
+    # ROADMAP item 4's closed loop: on a sustained throughput-regression
+    # incident, fit a TwinModel from this coordinator's own metrics JSONL
+    # and attach a bounded-sweep retuning recommendation to the incident —
+    # recommendation ONLY, nothing is applied. Costs a few seconds of
+    # virtual-time replay, at most once per incident.
+    retune_on_regression: bool = True
     # hub publication (run_first_peer.py:123-147 capability): a git working
     # tree (optionally pushing to hub_git_remote) or a directory mirror
     hub_git_dir: str = ""
@@ -113,6 +125,19 @@ def run_coordinator(
     wandb_run = _maybe_wandb(args)
     uploads = {"thread": None}  # per-coordinator upload state (NOT global:
     # tests run several coordinators in one process)
+    import threading
+
+    watch = None
+    # one twin retune in flight at a time; the lock serializes every
+    # incident-dict mutation/serialization between the fold loop and the
+    # retune thread
+    retunes = {"thread": None, "lock": threading.Lock()}
+    if extra.watchdog_enabled:
+        from dedloc_tpu.telemetry.watch import SwarmWatch
+
+        watch = SwarmWatch()
+    prev_health = None
+    prev_fold_t = None
     current_step = -1
     last_upload = get_dht_time()
     iterations = 0
@@ -126,10 +151,19 @@ def run_coordinator(
                 # swarm health (telemetry/health.py): per-peer retry/fault
                 # counters off the signed metrics bus folded into straggler
                 # attribution + retry rates — the durable "why was step N
-                # slow" record next to the throughput aggregate
-                health = build_swarm_health(metrics)
+                # slow" record next to the throughput aggregate. prev/dt
+                # window the derived rule rates between consecutive folds.
+                health = build_swarm_health(
+                    metrics,
+                    prev=prev_health,
+                    dt_s=(
+                        agg["time"] - prev_fold_t
+                        if prev_fold_t is not None else None
+                    ),
+                )
                 if health is not None:
                     agg["swarm_health"] = health
+                    prev_health, prev_fold_t = health, agg["time"]
                     if health["straggler"] is not None:
                         logger.warning(
                             f"step {agg['step']}: straggler "
@@ -144,6 +178,8 @@ def run_coordinator(
                     f.write(json.dumps(agg) + "\n")
                 if wandb_run is not None:
                     wandb_run.log(agg, step=agg["step"])
+                if watch is not None and health is not None:
+                    _watch_fold(watch, health, agg, extra, retunes)
 
                 if (
                     averager is not None
@@ -172,6 +208,136 @@ def run_coordinator(
             averager.shutdown()
         tele_close()
         dht.shutdown()
+
+
+def _load_own_rows(path: str) -> list:
+    """Rows of the coordinator's own metrics JSONL, through the SAME
+    hardened loader every post-hoc tool uses (utils/jsonl.py): a torn or
+    writer-jammed line salvages its complete objects here exactly as it
+    would under swarm_watch --recommend, so the self-retune twin fits
+    from the same rows. A not-yet-created log reads as empty."""
+    from dedloc_tpu.utils.jsonl import load_jsonl_rows
+
+    return load_jsonl_rows([path], warn=logger.warning, missing_ok=True)
+
+
+def _append_incident(extra, t, step, transition, incident) -> None:
+    """One transition record onto the incident JSONL (replayable by
+    ``runlog_summary --incidents``; for recorded logs the view keeps the
+    LAST state per incident id, so a later ``recommendation`` record
+    supersedes the bare ``retune_eligible`` one)."""
+    record = {
+        "t": t,
+        "step": step,
+        "watch": "incident",
+        "transition": transition,
+        # deep JSON copy: the live incident dict keeps mutating (effects,
+        # severity escalation) after this transition
+        "incident": json.loads(json.dumps(incident, default=str)),
+    }
+    try:
+        with open(extra.incident_log_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    except OSError as e:
+        logger.warning(f"cannot append incident log: {e}")
+
+
+def _spawn_retune(incident, agg, extra, retunes) -> None:
+    """Fit-and-recommend OFF the fold loop (same shape as the hub-upload
+    thread): the twin fit plus its bounded sweep costs seconds of replay,
+    and it must not stall metrics folding on exactly the fleet that is
+    already regressing. One retune in flight at a time; the follow-up
+    ``recommendation`` record lands when it finishes. The slow fit runs
+    into a LOCAL result; the live incident dict is only touched (and
+    serialized) under ``retunes["lock"]`` — the fold loop keeps appending
+    effects to the same dict while this thread runs."""
+    prev = retunes.get("thread")
+    if prev is not None and prev.is_alive():
+        incident["recommendation_reason"] = (
+            "retune skipped: a previous twin fit is still running"
+        )
+        return
+
+    def _do(incident=incident, t=agg["time"], step=agg["step"]):
+        try:
+            from dedloc_tpu.telemetry.watch import twin_recommendation
+
+            # fit from the coordinator's OWN durable log: on a bus-only
+            # fleet (health records carry no round summaries) this reports
+            # "no recommendation: <reason>" instead of guessing — point
+            # collected per-peer event logs at tools/swarm_watch.py
+            # --recommend for the full-fidelity fit
+            result = twin_recommendation(
+                _load_own_rows(extra.metrics_log_path)
+            )
+        except Exception as e:  # noqa: BLE001 — a retune failure must
+            # never take the watchdog (or the coordinator) down with it
+            result = {"no_recommendation": f"retune failed: {e!r}"}
+            logger.warning(f"watchdog retune failed: {e!r}")
+        with retunes["lock"]:
+            if "no_recommendation" in result:
+                incident["recommendation_reason"] = (
+                    result["no_recommendation"]
+                )
+            else:
+                incident["recommendation"] = result
+            _append_incident(extra, t, step, "recommendation", incident)
+
+    import threading
+
+    retunes["thread"] = threading.Thread(target=_do, daemon=True)
+    retunes["thread"].start()
+
+
+def _watch_fold(watch, health, agg, extra, retunes) -> None:
+    """One watchdog fold inline in the coordinator loop: stream the fresh
+    health record through the detectors, persist every incident transition
+    to the incident JSONL (same directory as the metrics log), surface it
+    as a ``watch.incident`` telemetry event, and — at most once per
+    eligible incident — kick off the background twin retune.
+
+    The WHOLE fold holds ``retunes["lock"]``: observe_health mutates live
+    incident dicts (effects, severity, representative round) that the
+    retune thread also mutates and serializes when its fit completes —
+    the fold is pure in-memory computation, so the retune thread waits at
+    most microseconds, never the other way around (the slow twin fit runs
+    OUTSIDE the lock)."""
+    with retunes["lock"]:
+        transitions = watch.observe_health(
+            health,
+            t=agg["time"],
+            step=agg["step"],
+            samples_per_sec=agg.get("samples_per_second"),
+        )
+        for tr in transitions:
+            incident = tr["incident"]
+            if (
+                tr["transition"] == "retune_eligible"
+                and extra.retune_on_regression
+            ):
+                _spawn_retune(incident, agg, extra, retunes)
+            _append_incident(
+                extra, agg["time"], agg["step"], tr["transition"], incident
+            )
+            telemetry.event(
+                "watch.incident",
+                transition=tr["transition"],
+                incident_id=incident["id"],
+                kind=incident["kind"],
+                metric=incident["metric"],
+                subject=incident["subject"],
+                severity=incident["severity"],
+                peer=incident.get("peer"),
+            )
+            log = (
+                logger.warning if tr["transition"] == "open"
+                else logger.info
+            )
+            log(
+                f"watchdog {tr['transition']}: [{incident['id']}] "
+                f"{incident['severity']} {incident['kind']} "
+                f"{incident['subject']} ({incident['metric']})"
+            )
 
 
 def _pull_and_save(args, averager, step, upload_fn, uploads) -> None:

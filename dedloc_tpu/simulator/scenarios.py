@@ -27,8 +27,11 @@ seed, and the engine freezes scenario time.
 from __future__ import annotations
 
 import asyncio
+import json
+import os
 import random
 import time
+from types import SimpleNamespace
 from typing import Any, Callable, Dict, List, Optional
 
 from dedloc_tpu.core.timeutils import get_dht_time
@@ -384,7 +387,9 @@ def _compute_for(spec: Dict[str, Any], peer) -> float:
 
 
 async def run_averaging_workload(swarm: SimSwarm,
-                                 spec: Dict[str, Any]) -> Dict[str, Any]:
+                                 spec: Dict[str, Any],
+                                 on_round: Optional[Callable] = None,
+                                 ) -> Dict[str, Any]:
     """Drive ``avg_rounds`` averaging rounds over ``swarm`` and return the
     measured report section. Spec keys (all optional)::
 
@@ -401,11 +406,25 @@ async def run_averaging_workload(swarm: SimSwarm,
         restore_bytes: 0       # >0: one sharded catalog restore at the end
         restore_providers: 4
         fetch_parallelism: 4
+        faults: []             # scripted mid-run faults, each fired at the
+                               # START of its round:
+                               #   {"kind": "link", "at_round": r,
+                               #    "src": host, "dst": host,
+                               #    bandwidth_bps/latency_s/loss/jitter_s}
+                               #     (omitted fields inherit the network
+                               #      default; a second fault with healthy
+                               #      numbers restores the link)
+                               #   {"kind": "straggler", "at_round": r,
+                               #    "peer": label, "factor": 8.0}
+                               #   {"kind": "churn", "at_round": r,
+                               #    "peers": [labels] | "count": n}
 
     Every member's exchange opens an ``avg.round`` span, feeds the link
     estimator per scatter chunk, and emits one ``allreduce.link`` event
     per remote hop — the event-log schema production peers write, so the
-    twin fitter (and --topology/--steps) consume the dump unchanged."""
+    twin fitter (and --topology/--steps) consume the dump unchanged.
+    ``on_round(r)`` (optional coroutine) runs after each round completes —
+    the watchdog scenario's coordinator-fold hook."""
     rounds = int(spec.get("avg_rounds", 4))
     group_size = int(spec.get("group_size", 8))
     span_bytes = max(1024, int(spec.get("span_bytes", 98304)))
@@ -419,6 +438,56 @@ async def run_averaging_workload(swarm: SimSwarm,
     participants = swarm.alive_peers()
     if len(participants) < 2:
         raise ValueError("averaging workload needs >= 2 live peers")
+
+    # scripted mid-run faults (the watchdog scenario's levers): applied at
+    # the START of their round, so detection-latency assertions can count
+    # folds from a known onset
+    faults = [dict(f) for f in (spec.get("faults") or [])]
+    compute_scale: Dict[str, float] = {}
+
+    def _scaled_compute(peer) -> float:
+        return _compute_for(spec, peer) * compute_scale.get(
+            peer.label, 1.0
+        )
+
+    async def apply_faults(r: int) -> None:
+        base = swarm.network.default_link
+        for f in faults:
+            if int(f.get("at_round", -1)) != r:
+                continue
+            kind = str(f.get("kind", ""))
+            if kind == "link":
+                swarm.network.set_link(
+                    str(f["src"]), str(f["dst"]),
+                    LinkSpec(
+                        latency_s=float(
+                            f.get("latency_s", base.latency_s)
+                        ),
+                        bandwidth_bps=float(
+                            f.get("bandwidth_bps", base.bandwidth_bps)
+                        ),
+                        loss=float(f.get("loss", base.loss)),
+                        jitter_s=float(f.get("jitter_s", base.jitter_s)),
+                    ),
+                )
+            elif kind == "straggler":
+                compute_scale[str(f["peer"])] = float(
+                    f.get("factor", 4.0)
+                )
+            elif kind == "churn":
+                named = set(f.get("peers") or [])
+                victims = [
+                    p for p in participants if p.alive and p.label in named
+                ]
+                if not victims and f.get("count"):
+                    # deterministic: the highest-indexed alive peers die
+                    victims = [p for p in participants if p.alive][
+                        -int(f["count"]):
+                    ]
+                for victim in victims:
+                    await swarm.kill(victim)
+            else:
+                raise ValueError(f"unknown fault kind {kind!r}")
 
     async def _part(_peer, _args):
         return {"ok": True}
@@ -562,6 +631,11 @@ async def run_averaging_workload(swarm: SimSwarm,
         wall = tele.clock() - t0
         member_walls.append(wall)
         per_peer_walls.setdefault(peer.label, []).append(wall)
+        # the member's wire wall IS its avg_wire step phase: the snapshot
+        # then carries step.phase.avg_wire.mean/count next to fwd_bwd, so
+        # a health fold over sim peers attributes wire-bound vs
+        # compute-bound exactly like a production flight-recorder peer
+        tele.histogram("step.phase.avg_wire").observe(wall)
         return wall
 
     # first/last boundary stamps: the samples/sec window. Defined over the
@@ -571,7 +645,7 @@ async def run_averaging_workload(swarm: SimSwarm,
 
     async def accumulate(peer, r: int) -> None:
         tele = peer.telemetry
-        compute = _compute_for(spec, peer)
+        compute = _scaled_compute(peer)
         for b in range(boundaries):
             await asyncio.sleep(compute)
             tele.histogram("step.phase.fwd_bwd").observe(compute)
@@ -589,6 +663,7 @@ async def run_averaging_workload(swarm: SimSwarm,
     async def one_round(r: int) -> None:
         nonlocal groups_formed
         round_id = f"avground-{r:04d}"
+        await apply_faults(r)
         alive = [p for p in participants if p.alive]
         acc_task = asyncio.gather(*(accumulate(p, r) for p in alive))
         if not overlap:
@@ -629,7 +704,7 @@ async def run_averaging_workload(swarm: SimSwarm,
             round_wall = max(walls)
             round_walls.append(round_wall)
             accum_wall = max(
-                _compute_for(spec, p) * boundaries for p in alive
+                _scaled_compute(p) * boundaries for p in alive
             )
             hidden = min(round_wall, accum_wall) if overlap else 0.0
             exposed = round_wall - hidden
@@ -641,6 +716,10 @@ async def run_averaging_workload(swarm: SimSwarm,
                 hidden_s=round(hidden, 6), exposed_s=round(exposed, 6),
                 efficiency=round(hidden / max(round_wall, 1e-9), 4),
             )
+        if on_round is not None:
+            # the coordinator-fold hook (watchdog scenario): runs while
+            # the round's telemetry is fresh, before the window idles
+            await on_round(r)
         # let leader-entry expirations clear so rounds stay disjoint
         await asyncio.sleep(window + 1.0)
 
@@ -778,6 +857,119 @@ async def phase_averaging(run: ScenarioRun) -> None:
     )
 
 
+# ----------------------------------------------------- watchdog scenario
+#
+# The live-watchdog proving ground: the averaging workload runs with
+# scripted mid-run faults while a simulated coordinator FOLDS swarm-health
+# records after every round (the production fold shape, built by the same
+# telemetry/health.build_swarm_health) and streams them through a
+# SwarmWatch inline — exactly the coordinator's live loop, in virtual
+# time. The folds dump to a coordinator-style JSONL so a post-hoc replay
+# (tools/swarm_watch.py) must reproduce the identical incident timeline.
+
+
+def fold_swarm_health(swarm: SimSwarm, step: int,
+                      state: Dict[str, Any]) -> Dict[str, Any]:
+    """One coordinator fold over the sim swarm: per-peer LocalMetrics-shaped
+    records built from each alive peer's telemetry snapshot (cumulative
+    counters + link table, exactly what the signed metrics bus carries),
+    plus the recent ``avg.round`` summaries a production bus cannot carry
+    (flat floats only) but the in-process fold can — the watchdog's
+    representative-trace attribution reads them. ``state`` is the fold's
+    mutable memory ({} on the first call)."""
+    now = get_dht_time()
+    last_t = state.get("t")
+    dt = (now - last_t) if last_t is not None else None
+    records = []
+    rounds: List[Dict[str, Any]] = []
+    for peer in swarm.alive_peers():
+        samples = 0.0
+        for r in peer.telemetry.events:
+            if last_t is not None and float(r.get("t", 0.0)) <= last_t:
+                continue
+            name = r.get("event")
+            if name == "step.record":
+                samples += float(r.get("samples", 0.0))
+            elif name == "avg.round":
+                rounds.append({
+                    "round_id": r.get("round_id"), "peer": peer.label,
+                    "dur_s": r.get("dur_s"), "ok": r.get("ok"),
+                    "group_size": r.get("group_size"),
+                    "trace": r.get("trace"),
+                })
+        records.append(SimpleNamespace(
+            peer=peer.label,
+            step=int(step),
+            samples_per_second=(
+                round(samples / dt, 3) if dt and dt > 0 else 0.0
+            ),
+            step_time_ms=None,
+            telemetry=peer.telemetry.snapshot(),
+            endpoint=endpoint_key(peer.endpoint),
+        ))
+    from dedloc_tpu.telemetry.health import build_swarm_health
+
+    health = build_swarm_health(
+        records, rounds=rounds, prev=state.get("health"), dt_s=dt
+    )
+    state["t"] = now
+    state["health"] = health
+    return {"step": int(step), "time": now, "swarm_health": health}
+
+
+def _watch_config(spec: Dict[str, Any]):
+    from dedloc_tpu.telemetry.watch import WatchConfig
+
+    cfg = WatchConfig()
+    for key, value in (spec.get("watch") or {}).items():
+        if not hasattr(cfg, key):
+            raise ValueError(f"unknown watch config key {key!r}")
+        setattr(cfg, key, type(getattr(cfg, key))(value))
+    return cfg
+
+
+async def _scenario_watchdog(run: ScenarioRun) -> None:
+    from dedloc_tpu.telemetry.watch import SwarmWatch
+
+    await phase_spawn(run)
+    run.report["link_overrides"] = apply_link_overrides(
+        run.network,
+        [p.host for p in run.swarm.peers],
+        run.spec.get("links"),
+    )
+    watch = SwarmWatch(_watch_config(run.spec))
+    fold_state: Dict[str, Any] = {}
+    folds: List[Dict[str, Any]] = []
+    transitions: List[Dict[str, Any]] = []
+
+    async def on_round(r: int) -> None:
+        row = fold_swarm_health(run.swarm, r, fold_state)
+        folds.append(row)
+        if row["swarm_health"] is None:
+            # a scripted churn wave can wipe out EVERY peer: the fold is
+            # kept as evidence in the dump, but there is nothing to
+            # observe — watch_rows skips null health rows the same way,
+            # so live and replay stay identical
+            return
+        for tr in watch.observe_health(
+            row["swarm_health"], t=row["time"], step=r
+        ):
+            transitions.append({
+                "fold": watch.fold,
+                "transition": tr["transition"],
+                "incident": tr["incident"]["id"],
+                "kind": tr["incident"]["kind"],
+                "subject": tr["incident"]["subject"],
+            })
+
+    run.report["averaging"] = await run_averaging_workload(
+        run.swarm, run.spec, on_round=on_round
+    )
+    run.report["watch"] = watch.summary()
+    run.report["transitions"] = transitions
+    run.report["health_folds"] = folds
+
+
 # -------------------------------------------------------------- scenarios
 
 
@@ -825,6 +1017,7 @@ SCENARIOS: Dict[str, Callable] = {
     "catalog": _scenario_catalog,
     "mixed": _scenario_mixed,
     "averaging": _scenario_averaging,
+    "watchdog": _scenario_watchdog,
     # resolved specially by run_scenario: replays a fitted TwinModel
     # (dedloc_tpu/twin) instead of building a swarm from spec numbers
     "twin_replay": None,
@@ -893,6 +1086,16 @@ def run_scenario(
             }
             if out_dir is not None:
                 run.report["event_logs"] = run.swarm.dump_event_logs(out_dir)
+                if run.report.get("health_folds"):
+                    # the coordinator-style JSONL (one row per fold, the
+                    # production metrics-log shape): the post-hoc replay
+                    # surface for tools/swarm_watch.py and
+                    # runlog_summary --incidents
+                    path = os.path.join(out_dir, "coordinator.jsonl")
+                    with open(path, "w", encoding="utf-8") as f:
+                        for row in run.report["health_folds"]:
+                            f.write(json.dumps(row) + "\n")
+                    run.report["coordinator_log"] = path
     finally:
         run.engine.close()
     return run.report

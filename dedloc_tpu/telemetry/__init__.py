@@ -10,8 +10,15 @@ from __future__ import annotations
 from typing import Optional
 
 from dedloc_tpu.telemetry import registry, steps
-from dedloc_tpu.telemetry.health import build_swarm_health, build_topology
+from dedloc_tpu.telemetry.health import (
+    RULE_THRESHOLDS,
+    build_swarm_health,
+    build_topology,
+    derive_rates,
+    verdict_from_rates,
+)
 from dedloc_tpu.telemetry.links import LinkTable, endpoint_key
+from dedloc_tpu.telemetry.watch import SwarmWatch, WatchConfig, watch_rows
 from dedloc_tpu.telemetry.steps import StepRecorder
 from dedloc_tpu.telemetry.registry import (
     Counter,
@@ -38,19 +45,25 @@ __all__ = [
     "Gauge",
     "Histogram",
     "LinkTable",
+    "RULE_THRESHOLDS",
     "StepRecorder",
+    "SwarmWatch",
     "Telemetry",
+    "WatchConfig",
     "active",
     "adopt_trace",
     "build_swarm_health",
     "build_topology",
     "configure",
     "current_trace",
+    "derive_rates",
     "enabled",
     "endpoint_key",
     "event",
     "inc",
     "install",
+    "verdict_from_rates",
+    "watch_rows",
     "monotonic_clock",
     "new_span_id",
     "registry",

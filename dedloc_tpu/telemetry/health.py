@@ -28,7 +28,32 @@ builds simply lack them — their rows fold unchanged.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Shared rule thresholds: ONE definition consumed by the swarm-health verdict
+# below, the live watchdog (telemetry/watch.py) and the runlog_summary
+# --health header — the live view and the post-hoc view can never disagree
+# about what counts as DEGRADED because they read the same numbers.
+# ---------------------------------------------------------------------------
+RULE_THRESHOLDS: Dict[str, float] = {
+    # aborted matchmaking rounds per attempted round (swarm-wide)
+    "round_abort_rate": 0.25,
+    # form_group attempts that never produced a group, per attempt.
+    # NOT raw mm.join_failures: those count internal leader-race retries,
+    # which run ~7x per formed round on a perfectly healthy contended
+    # flat swarm (the ROADMAP-item-1 contention measurement) — an attempt
+    # that eventually forms is a success, however many retries it took
+    "join_failure_rate": 0.5,
+    # connection deaths per minute, swarm-wide (needs timestamps; callers
+    # without a time axis skip this rule rather than guess)
+    "conns_lost_per_min": 6.0,
+    # one peer's connection deaths per RPC call — a flapping NAT/firewall
+    "peer_loss_ratio": 0.05,
+    # steps behind the swarm before a peer is attributed (the existing
+    # straggler semantics: behind==1 is publish skew, not a stall)
+    "behind_steps": 2.0,
+}
 
 # counter names lifted from the instrumented seams; a missing key reads 0.0
 # so peers running older builds (no telemetry tail) still aggregate
@@ -68,27 +93,43 @@ def _peer_entry(m, current_step: int) -> Dict:
     form = t.get("mm.form_group.mean")
     if form is not None:
         entry["round_formation_s"] = float(form)
+        # the matching sample count lets a streaming consumer (the
+        # watchdog) recover the PER-WINDOW mean between two folds from
+        # cumulative statistics: mean_w = (c2*m2 - c1*m1) / (c2 - c1)
+        count = t.get("mm.form_group.count")
+        if count is not None:
+            entry["round_formation_count"] = float(count)
     round_dur = t.get("avg.round.mean")
     if round_dur is not None:
         entry["round_s"] = float(round_dur)
+        count = t.get("avg.round.count")
+        if count is not None:
+            entry["round_count"] = float(count)
     # step-phase flight recorder (telemetry/steps.py): per-phase mean
     # seconds from the snapshot's ``step.phase.<name>.mean`` histogram keys,
     # plus the dominant phase — the coordinator-side half of "why was step N
     # slow now ends in a PHASE". Absent for pre-recorder peers (no keys).
     phases = {}
+    phase_counts = {}
     for key, value in t.items():
-        if (
-            isinstance(key, str)
-            and key.startswith("step.phase.")
-            and key.endswith(".mean")
-        ):
-            try:
+        if not isinstance(key, str) or not key.startswith("step.phase."):
+            continue
+        try:
+            if key.endswith(".mean"):
                 phases[key[len("step.phase."):-len(".mean")]] = float(value)
-            except (TypeError, ValueError):
-                continue
+            elif key.endswith(".count"):
+                phase_counts[
+                    key[len("step.phase."):-len(".count")]
+                ] = float(value)
+        except (TypeError, ValueError):
+            continue
     if phases:
         entry["phases"] = phases
         entry["dominant_phase"] = max(phases, key=phases.get)
+        if phase_counts:
+            # per-phase sample counts: the windowing companion to the
+            # cumulative means (same rationale as round_count above)
+            entry["phase_counts"] = phase_counts
     mfu = t.get("step.mfu")
     if mfu is not None:
         entry["mfu"] = float(mfu)
@@ -182,7 +223,7 @@ def _straggler(peers: List[Dict]) -> Optional[str]:
     if not peers:
         return None
     behind = max(peers, key=lambda p: p["behind"])
-    if behind["behind"] >= 2:
+    if behind["behind"] >= RULE_THRESHOLDS["behind_steps"]:
         return behind["peer"]
     timed = [p for p in peers if p.get("step_time_ms") is not None]
     if len(timed) >= 2:
@@ -195,10 +236,115 @@ def _straggler(peers: List[Dict]) -> Optional[str]:
     return None
 
 
-def build_swarm_health(records) -> Optional[Dict]:
+def derive_rates(
+    health: Dict,
+    prev: Optional[Dict] = None,
+    dt_s: Optional[float] = None,
+) -> Dict[str, float]:
+    """Swarm-level derived rates the rule detectors read — computed from
+    ONE swarm-health record's cumulative counters, or WINDOWED between two
+    consecutive records when ``prev`` is given (the streaming watchdog's
+    case; ``dt_s`` additionally unlocks the per-minute rates).
+
+    Returned keys (each absent when its inputs are, never guessed):
+    ``round_abort_rate``, ``join_failure_rate``, ``conns_lost`` (count over
+    the window / lifetime), ``conns_lost_per_min`` (needs ``dt_s``),
+    ``peer_loss_ratio`` (the worst peer's conns-lost per RPC call) and
+    ``peer_loss_ratio_peer`` (who that is)."""
+
+    def total(record: Optional[Dict], key: str) -> float:
+        if not record:
+            return 0.0
+        return sum(
+            float(p.get(key, 0.0)) for p in record.get("peers", [])
+            if isinstance(p, dict)
+        )
+
+    def window(key: str) -> float:
+        # clamped at 0: a peer set that shrank (churn) can make the
+        # cumulative swarm sum regress without anything "un-happening"
+        return max(0.0, total(health, key) - total(prev, key))
+
+    rates: Dict[str, float] = {}
+    attempted = window("rounds_attempted")
+    aborted = window("rounds_aborted")
+    if attempted > 0:
+        rates["round_abort_rate"] = round(aborted / attempted, 4)
+    formed = window("rounds_formed")
+    if attempted > 0:
+        # attempts that never produced a group (clamped: formed can lag
+        # attempted by in-flight rounds at the fold boundary)
+        rates["join_failure_rate"] = round(
+            max(0.0, attempted - formed) / attempted, 4
+        )
+        # informational contention gauge, no rule attached: internal
+        # leader-race retries per attempt — high on any contended flat
+        # swarm, interesting for sizing, not an incident
+        rates["join_retries_per_attempt"] = round(
+            window("join_failures") / attempted, 2
+        )
+    conns_lost = window("conns_lost")
+    rates["conns_lost"] = round(conns_lost, 1)
+    if dt_s is not None and dt_s > 0:
+        rates["conns_lost_per_min"] = round(conns_lost / (dt_s / 60.0), 3)
+    worst_ratio, worst_peer = 0.0, None
+    for p in health.get("peers", []):
+        if not isinstance(p, dict):
+            continue
+        calls = float(p.get("rpc_calls", 0.0))
+        lost = float(p.get("conns_lost", 0.0))
+        # ratios stay cumulative even in windowed mode: per-peer windows
+        # need the prev record's matching peer row, and a lifetime ratio
+        # is the conservative (non-flapping) reading for a rule threshold
+        if calls >= 20 and lost / calls > worst_ratio:
+            worst_ratio, worst_peer = lost / calls, p.get("peer")
+    if worst_peer is not None and worst_ratio > 0:
+        rates["peer_loss_ratio"] = round(worst_ratio, 4)
+        rates["peer_loss_ratio_peer"] = worst_peer
+    return rates
+
+
+def verdict_from_rates(
+    rates: Dict[str, Any], straggler: Optional[str] = None
+) -> Tuple[str, str]:
+    """("OK"|"DEGRADED", reason) from a derived-rates dict — THE shared
+    rule evaluation: ``runlog_summary --health``'s header, the coordinator
+    fold and the watchdog all call this with RULE_THRESHOLDS applied to
+    whatever rates their input could support."""
+    reasons: List[str] = []
+    for key in ("round_abort_rate", "join_failure_rate",
+                "conns_lost_per_min", "peer_loss_ratio"):
+        value = rates.get(key)
+        if value is None:
+            continue
+        limit = RULE_THRESHOLDS[key]
+        if float(value) > limit:
+            tag = f"{key} {float(value):.3g} > {limit:g}"
+            if key == "peer_loss_ratio" and rates.get(
+                "peer_loss_ratio_peer"
+            ):
+                tag += f" ({rates['peer_loss_ratio_peer']})"
+            reasons.append(tag)
+    if straggler:
+        reasons.append(f"straggler {straggler}")
+    if reasons:
+        return "DEGRADED", "; ".join(reasons)
+    return "OK", "all rule rates within thresholds"
+
+
+def build_swarm_health(records, rounds: Optional[List[Dict]] = None,
+                       prev: Optional[Dict] = None,
+                       dt_s: Optional[float] = None) -> Optional[Dict]:
     """Fold fetched per-peer ``LocalMetrics`` (collaborative/metrics.py)
     into one swarm-health record. Returns None when there are no records;
-    peers without a telemetry tail still contribute step/throughput rows."""
+    peers without a telemetry tail still contribute step/throughput rows.
+
+    ``rounds`` (optional) attaches recent round summaries
+    (``[{"round_id", "peer", "dur_s", "ok", "trace"?}, ...]``) when the
+    folder has them — the simulator's coordinator fold does; the production
+    metrics bus carries only flat floats, so a live coordinator's records
+    simply lack the field and the watchdog reports that in its coverage.
+    ``prev``/``dt_s`` window the derived rates against the previous fold."""
     if not records:
         return None
     current_step = max(m.step for m in records)
@@ -217,9 +363,18 @@ def build_swarm_health(records) -> Optional[Dict]:
     }
     if formation:
         health["round_formation_s"] = sum(formation) / len(formation)
+    if rounds:
+        health["rounds"] = rounds
     # swarm topology (per-link telemetry): absent — not an error — when no
     # peer reports link estimates (telemetry off, or a pre-link fleet)
     topology = build_topology(records)
     if topology is not None:
         health["topology"] = topology
+    # swarm-level derived rates + the one-line verdict, from the SAME rule
+    # set the watchdog runs (RULE_THRESHOLDS) — the fold and the live view
+    # cannot disagree
+    rates = derive_rates(health, prev=prev, dt_s=dt_s)
+    health["derived"] = rates
+    status, reason = verdict_from_rates(rates, health["straggler"])
+    health["verdict"] = {"status": status, "reason": reason}
     return health

@@ -232,11 +232,40 @@ def fit_twin(rows: List[Dict[str, Any]],
         if LINK_KEY_SEP in str(r.get("peer", "")) else r
         for r in events
     ]
-    healths = [
-        r["swarm_health"] for r in rows
+    health_rows = [
+        r for r in rows
         if isinstance(r, dict) and isinstance(r.get("swarm_health"), dict)
     ]
+    healths = [r["swarm_health"] for r in health_rows]
     warnings: List[str] = []
+
+    # a coordinator JSONL whose folds carry recent-round summaries (the
+    # in-process/simulator fold does; the flat production metrics bus
+    # cannot) fits round walls and workload shape from the coordinator's
+    # own log — the watchdog's self-retune path. Adopted as avg.round rows
+    # ONLY when no per-peer event log contributed real ones, so feeding
+    # both never double-counts a round.
+    rounds_from_folds = 0
+    if not any(r.get("event") == "avg.round" for r in events):
+        for row in health_rows:
+            fold_t = row.get("time")
+            for rd in row["swarm_health"].get("rounds") or []:
+                if not isinstance(rd, dict) or rd.get("dur_s") is None:
+                    continue
+                synthetic = {
+                    "event": "avg.round",
+                    "peer": safe_label(rd.get("peer", "?")),
+                    "round_id": rd.get("round_id"),
+                    "dur_s": float(rd["dur_s"]),
+                    "ok": rd.get("ok", True),
+                    # the fold stamps its time at the round's tail — the
+                    # same span-exit convention real avg.round events use
+                    "t": float(fold_t) if fold_t is not None else 0.0,
+                }
+                if rd.get("group_size") is not None:
+                    synthetic["group_size"] = rd["group_size"]
+                events.append(synthetic)
+                rounds_from_folds += 1
 
     # ---------------------------------------------------------- peer roster
     labels = {
@@ -797,6 +826,7 @@ def fit_twin(rows: List[Dict[str, Any]],
         "links_with_uplink_estimate": links_with_uplink,
         "links_from_wire_aggregates": links_from_wire,
         "links_with_loss": links_with_loss,
+        "rounds_from_health_folds": rounds_from_folds,
         "workload_from_config_fields": config_fields,
         "defaults_used": sorted(
             ({"links"} if not links else set())

@@ -98,6 +98,30 @@ def test_gate_warns_but_does_not_fail_on_missing_tests():
     assert "warning" in text and "test_renamed_away" in text
 
 
+def test_gate_reports_floor_baselined_tests_once_as_informational():
+    """Sub-5ms tests are baselined at the 0.01s recording floor and pytest
+    hides them from every durations block — expected noise
+    (memory/tier1-box-facts.md), so ONE info line, not a warning per test,
+    and the exit status is untouched."""
+    rows = t1_budget.parse_durations(_LOG.splitlines())
+    baseline = {
+        "tests/test_core.py::test_quick": 3.0,
+        "tests/test_fast.py::test_sub_5ms_a": 0.01,
+        "tests/test_fast.py::test_sub_5ms_b": 0.01,
+        "tests/test_gone.py::test_renamed_away": 5.0,
+    }
+    text, code = t1_budget.gate(rows, baseline)
+    assert code == 0
+    info_lines = [l for l in text.splitlines() if l.startswith("info:")]
+    assert len(info_lines) == 1
+    assert "2 baselined sub-5ms test(s)" in info_lines[0]
+    assert "test_sub_5ms_a" in info_lines[0]
+    # floor entries never WARN; genuinely missing tests still do
+    warn_lines = [l for l in text.splitlines() if "warning" in l]
+    assert len(warn_lines) == 1 and "test_renamed_away" in warn_lines[0]
+    assert "1/4" in text  # passed-count excludes both kinds of missing
+
+
 def test_record_baseline_roundtrips_into_gate():
     rows = t1_budget.parse_durations(_LOG.splitlines())
     baseline = t1_budget.record_baseline(rows, [])

@@ -1,0 +1,502 @@
+"""Live swarm watchdog (ISSUE 12): streaming anomaly detection over the
+health fold, incident timeline with root-cause attribution, twin-backed
+retuning recommendations.
+
+Acceptance (all virtual-time, deterministic, marker ``simulator``): a
+watchdog scenario that degrades one directed link mid-run, turns one peer
+into a straggler and injects a churn wave yields exactly those incidents —
+each detected within a bounded number of health folds, each attributing
+the correct peer/link/phase, the link incident's representative trace id
+resolvable by ``runlog_summary --trace`` — while the same scenario with no
+faults (two seeds) yields zero incidents, and a post-hoc replay of the
+dumped coordinator JSONL through the same code path reproduces the
+identical incident timeline. A sustained throughput regression carries a
+twin-backed recommendation with a fidelity-bounded interval; insufficient
+coverage reports a reason instead of guessing.
+"""
+import copy
+import importlib.util
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+from dedloc_tpu.simulator.scenarios import run_scenario
+from dedloc_tpu.telemetry.health import (
+    RULE_THRESHOLDS,
+    derive_rates,
+    verdict_from_rates,
+)
+from dedloc_tpu.telemetry.watch import (
+    SwarmWatch,
+    WatchConfig,
+    twin_recommendation,
+    watch_rows,
+)
+
+pytestmark = pytest.mark.simulator
+
+_TOOLS = Path(__file__).resolve().parent.parent / "tools"
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(name, _TOOLS / f"{name}.py")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+# order matters: swarm_watch resolves `runlog_summary` via sys.modules
+runlog_summary = _load_tool("runlog_summary")
+import sys  # noqa: E402
+
+sys.modules.setdefault("runlog_summary", runlog_summary)
+swarm_watch = _load_tool("swarm_watch")
+
+
+BASE_SPEC = {
+    "scenario": "watchdog", "peers": 10, "seed": 3,
+    "link": {"latency_s": 0.004, "bandwidth_bps": 8e6},
+    "avg_rounds": 12, "group_size": 10,
+    "span_bytes": 32 * 1024, "chunk_bytes": 8 * 1024,
+    "boundaries": 1, "compute_s": 0.05, "window_s": 2.0,
+}
+
+# onset rounds for the three scripted faults (fold == round index)
+LINK_ONSET, STRAGGLER_ONSET, CHURN_ONSET = 4, 6, 9
+DETECTION_BOUND = 3  # folds from onset within which each must open
+
+FAULTS = [
+    {"kind": "link", "at_round": LINK_ONSET, "src": "peer-0001",
+     "dst": "peer-0003", "latency_s": 0.25},
+    {"kind": "link", "at_round": 7, "src": "peer-0001",
+     "dst": "peer-0003"},  # restore: the incident must CLOSE
+    {"kind": "straggler", "at_round": STRAGGLER_ONSET,
+     "peer": "peer-0005", "factor": 8.0},
+    {"kind": "churn", "at_round": CHURN_ONSET, "count": 2},
+]
+
+
+@pytest.fixture(scope="module")
+def faulted_run(tmp_path_factory):
+    out = tmp_path_factory.mktemp("watchdog_faulted")
+    report = run_scenario(
+        dict(BASE_SPEC, faults=copy.deepcopy(FAULTS)), out_dir=str(out)
+    )
+    return report
+
+
+@pytest.fixture(scope="module")
+def regression_run(tmp_path_factory):
+    """Global bandwidth collapse: a swarm-wide throughput regression with
+    no single peer/link standing out — the twin-retune trigger."""
+    out = tmp_path_factory.mktemp("watchdog_regression")
+    spec = dict(BASE_SPEC, avg_rounds=10, faults=[
+        {"kind": "link", "at_round": 5, "src": f"peer-{i:04d}",
+         "dst": f"peer-{j:04d}", "bandwidth_bps": 1e6}
+        for i in range(10) for j in range(10) if i != j
+    ])
+    return run_scenario(spec, out_dir=str(out))
+
+
+# ------------------------------------------------------------ acceptance
+
+
+def test_clean_runs_zero_incidents_two_seeds():
+    for seed in (3, 11):
+        report = run_scenario(dict(BASE_SPEC, seed=seed))
+        watch = report["watch"]
+        assert watch["incidents"] == [], (seed, watch["incidents"])
+        assert watch["folds"] == BASE_SPEC["avg_rounds"]
+        assert watch["verdict"]["status"] == "OK"
+        # nothing was degraded, and nothing was silently skipped either
+        cov = watch["coverage"]
+        assert cov["folds_with_topology"] == cov["folds"]
+        assert cov["folds_with_phases"] == cov["folds"]
+        assert cov["folds_with_rounds"] == cov["folds"]
+
+
+def test_faulted_scenario_detects_exactly_the_three_faults(faulted_run):
+    incidents = faulted_run["watch"]["incidents"]
+    kinds = sorted(i["kind"] for i in incidents)
+    # the three faults and nothing else: the one directed-link latency
+    # fault legitimately shows on BOTH directed measurements of the pair
+    # (each direction's request/ack chain rides the degraded path)
+    assert set(kinds) == {"link_degraded", "peer_degraded", "churn_wave"}
+
+    links = [i for i in incidents if i["kind"] == "link_degraded"]
+    assert links, "link incident missing"
+    for inc in links:
+        pair = {inc["link"]["src"], inc["link"]["dst"]}
+        assert pair == {"peer-0001", "peer-0003"}, inc["link"]
+        assert inc["opened_fold"] - LINK_ONSET <= DETECTION_BOUND
+        assert inc["phase"] == "avg_wire"
+        assert inc["severity"] == "critical"
+        # the link was restored at round 7: hysteresis must CLOSE the
+        # incident cleanly, not flap it
+        assert inc["status"] == "closed"
+        assert inc["closed_fold"] is not None
+        # swarm-level collateral folded into the root incident
+        assert any(
+            e["metric"].startswith("round_wall") for e in inc["effects"]
+        )
+    assert any(
+        i["link"] == {"src": "peer-0001", "dst": "peer-0003"} for i in links
+    ), "the faulted direction itself must be attributed"
+
+    (straggler,) = [i for i in incidents if i["kind"] == "peer_degraded"]
+    assert straggler["peer"] == "peer-0005"
+    assert straggler["phase"] == "fwd_bwd"
+    assert straggler["metric"] == "peer_phase.fwd_bwd"
+    assert straggler["opened_fold"] - STRAGGLER_ONSET <= DETECTION_BOUND
+    assert straggler["status"] == "open"  # never repaired in-run
+    # the 8x compute fault reads back quantitatively
+    assert straggler["observed"] == pytest.approx(0.4, rel=0.1)
+    assert straggler["baseline"] == pytest.approx(0.05, rel=0.1)
+
+    (churn,) = [i for i in incidents if i["kind"] == "churn_wave"]
+    assert churn["peers_lost"] == ["peer-0008", "peer-0009"]
+    assert churn["opened_fold"] - CHURN_ONSET <= 1
+    assert churn["status"] == "closed"  # wave ended; membership stabilized
+
+
+def test_link_incident_trace_resolves_through_runlog_trace(faulted_run):
+    link = [
+        i for i in faulted_run["watch"]["incidents"]
+        if i["kind"] == "link_degraded"
+    ][0]
+    assert link["round_id"] and link["trace"]
+    rows = runlog_summary.load_events(faulted_run["event_logs"])
+    doc = runlog_summary.trace_data(rows, link["round_id"])
+    assert link["trace"] in doc["traces"]
+    # the trace stitches the whole group, including the attributed peer
+    assert link["peer"] in doc["peers"]
+
+
+def test_posthoc_replay_reproduces_identical_timeline(faulted_run):
+    """THE same-code-path guarantee: replaying the dumped coordinator
+    JSONL through swarm_watch reproduces the live (inline, virtual-time)
+    incident timeline bit-for-bit."""
+    rows = runlog_summary.load_jsonl_rows([faulted_run["coordinator_log"]])
+    replayed = watch_rows(rows).summary()
+    live = faulted_run["watch"]
+    assert json.dumps(replayed, sort_keys=True, default=str) == \
+        json.dumps(live, sort_keys=True, default=str)
+
+
+def test_regression_single_incident_with_twin_recommendation(
+    regression_run,
+):
+    incidents = regression_run["watch"]["incidents"]
+    # one root incident; further swarm metrics fold into its effects
+    assert len(incidents) == 1, incidents
+    (inc,) = incidents
+    assert inc["kind"] == "swarm_regression"
+    assert inc["metric"].startswith("round_wall")
+    assert inc["retune_eligible"] is True
+
+    rows = runlog_summary.load_jsonl_rows(
+        [regression_run["coordinator_log"]]
+    )
+    rec = twin_recommendation(rows, seed=0)
+    assert "no_recommendation" not in rec, rec
+    assert rec["predicted_samples_per_sec"] > 0
+    lo, hi = rec["interval"]
+    assert lo <= rec["predicted_samples_per_sec"] <= hi
+    assert 0 < rec["fidelity_bound"] <= 1.0
+    assert rec["config"]  # a concrete averager config to try
+
+
+def test_insufficient_coverage_reports_reason_not_a_guess():
+    # an all-old swarm's coordinator JSONL: peers but no links, no phases,
+    # no round summaries — every gate names its reason
+    rows = [
+        {"step": 5, "time": 100.0, "swarm_health": {
+            "current_step": 5,
+            "peers": [
+                {"peer": "v1", "step": 5, "rpc_calls": 100.0},
+                {"peer": "v2", "step": 5, "rpc_calls": 90.0},
+            ],
+        }},
+    ]
+    rec = twin_recommendation(rows)
+    assert "no_recommendation" in rec
+    assert "coverage" in rec["no_recommendation"]
+    # and a completely unfittable input
+    rec = twin_recommendation([{"not": "telemetry"}])
+    assert "not fittable" in rec["no_recommendation"]
+
+
+# --------------------------------------------------------- hostile inputs
+
+
+def test_watch_survives_jammed_and_truncated_coordinator_jsonl(
+    faulted_run, tmp_path, capsys
+):
+    lines = [
+        json.dumps(row) for row in [
+            {"step": r["step"], "time": r["time"],
+             "swarm_health": r["swarm_health"]}
+            for r in _folds_of(faulted_run)
+        ]
+    ]
+    jammed = tmp_path / "jam.jsonl"
+    # jam folds 2+3 onto one line, truncate the final line mid-object
+    jammed.write_text(
+        "\n".join(lines[:2]) + "\n"
+        + lines[2] + lines[3] + "\n"
+        + "\n".join(lines[4:-1]) + "\n"
+        + lines[-1][: len(lines[-1]) // 2]
+    )
+    rows = runlog_summary.load_jsonl_rows([str(jammed)])
+    assert "skipped" in capsys.readouterr().err
+    watch = watch_rows(rows)
+    # every complete fold was salvaged; only the torn tail is gone
+    assert watch.coverage["folds"] == len(lines) - 1
+    kinds = {i["kind"] for i in watch.incidents}
+    assert "link_degraded" in kinds and "peer_degraded" in kinds
+
+
+def _folds_of(report):
+    return report["health_folds"]
+
+
+def test_pre_schema_clean_log_degrades_with_report_no_false_incidents():
+    """A clean run's folds stripped back to the pre-link/pre-step schema:
+    the watchdog idles the unavailable detectors, NAMES every blind spot
+    in coverage, and fabricates nothing."""
+    report = run_scenario(dict(BASE_SPEC, avg_rounds=8))
+    stripped = []
+    for row in _folds_of(report):
+        health = copy.deepcopy(row["swarm_health"])
+        health.pop("topology", None)
+        health.pop("rounds", None)
+        for p in health["peers"]:
+            for key in ("phases", "phase_counts", "dominant_phase",
+                        "round_s", "round_count", "round_formation_s",
+                        "round_formation_count"):
+                p.pop(key, None)
+        stripped.append({"step": row["step"], "time": row["time"],
+                         "swarm_health": health})
+    watch = watch_rows(stripped)
+    assert watch.incidents == []
+    summary = watch.summary()
+    notes = " ".join(summary["coverage"]["notes"])
+    assert "link detectors idle" in notes
+    assert "phase attribution unavailable" in notes
+    assert "representative-trace attribution unavailable" in notes
+    assert summary["coverage"]["folds_with_topology"] == 0
+
+
+def test_churn_wipeout_keeps_scenario_alive_and_fold_as_evidence():
+    """A scripted churn wave that kills EVERY peer: the scenario must
+    finish (not crash on a peer-less fold), keep the empty fold in the
+    dump as evidence, and live detection must match what a replay of the
+    dump would do (watch_rows skips null health rows the same way)."""
+    spec = dict(BASE_SPEC, peers=4, group_size=4, avg_rounds=5, faults=[
+        {"kind": "churn", "at_round": 3, "count": 4},
+    ])
+    report = run_scenario(spec)
+    rows = report["health_folds"]
+    assert any(r["swarm_health"] is None for r in rows)
+    # folds observed = folds with actual health records, live == replay
+    live = report["watch"]["folds"]
+    assert live == sum(1 for r in rows if r["swarm_health"] is not None)
+
+
+def test_zero_baseline_is_unjudgeable_not_infinitely_bad():
+    """A metric whose baseline settled at exactly 0 has no scale: any
+    later nonzero value must read as unjudgeable 'mid' (the window then
+    learns the real level) — never an infinite-deviation critical
+    incident whose JSON serializes as non-RFC Infinity."""
+    from dedloc_tpu.telemetry.watch import _Detector
+
+    cfg = WatchConfig()
+    d = _Detector("peer_phase.data_wait", "peer:a", False, cfg)
+    for _ in range(cfg.warmup_folds + 1):
+        d.baseline.add(0.0)
+    verdict, dev = d.judge(0.001, cfg)
+    assert verdict == "mid"
+    assert dev == 0.0  # finite, JSON-safe
+
+
+def test_no_timestamps_skips_per_minute_rules_with_note():
+    watch = SwarmWatch()
+    for i in range(5):
+        watch.observe_health({
+            "current_step": i,
+            "peers": [{"peer": "a", "step": i, "conns_lost": 1000.0 * i,
+                       "rpc_calls": 10.0}],
+        })
+    summary = watch.summary()
+    assert summary["incidents"] == []  # no dt -> no per-minute rate rule
+    assert any("per-minute" in n for n in summary["coverage"]["notes"])
+
+
+# ------------------------------------------------- shared rules / verdict
+
+
+def test_derive_rates_and_verdict_shared_thresholds():
+    health = {
+        "peers": [
+            {"peer": "a", "rounds_attempted": 10.0, "rounds_formed": 4.0,
+             "rounds_aborted": 3.0, "join_failures": 70.0,
+             "conns_lost": 12.0, "rpc_calls": 100.0},
+        ],
+    }
+    rates = derive_rates(health, dt_s=60.0)
+    assert rates["round_abort_rate"] == pytest.approx(0.3)
+    assert rates["join_failure_rate"] == pytest.approx(0.6)
+    assert rates["join_retries_per_attempt"] == pytest.approx(7.0)
+    assert rates["conns_lost_per_min"] == pytest.approx(12.0)
+    assert rates["peer_loss_ratio"] == pytest.approx(0.12)
+    status, reason = verdict_from_rates(rates)
+    assert status == "DEGRADED"
+    for key in ("round_abort_rate", "conns_lost_per_min",
+                "peer_loss_ratio"):
+        assert key in reason
+    # windowed: the second fold's deltas, not lifetime sums
+    later = {
+        "peers": [
+            {"peer": "a", "rounds_attempted": 20.0, "rounds_formed": 14.0,
+             "rounds_aborted": 3.0, "join_failures": 75.0,
+             "conns_lost": 12.0, "rpc_calls": 200.0},
+        ],
+    }
+    windowed = derive_rates(later, prev=health, dt_s=60.0)
+    assert windowed["round_abort_rate"] == pytest.approx(0.0)
+    assert windowed["join_failure_rate"] == pytest.approx(0.0)
+    assert windowed["conns_lost_per_min"] == pytest.approx(0.0)
+    ok_status, _ = verdict_from_rates(
+        {k: v for k, v in windowed.items() if k != "peer_loss_ratio"}
+    )
+    assert ok_status == "OK"
+    assert set(RULE_THRESHOLDS) >= {
+        "round_abort_rate", "join_failure_rate", "conns_lost_per_min",
+        "peer_loss_ratio",
+    }
+
+
+def test_hysteresis_no_flapping_on_boundary_oscillation():
+    """A metric oscillating around the open threshold must not open/close
+    an incident per fold: the close threshold is tighter than the open
+    threshold, and both need consecutive folds."""
+    cfg = WatchConfig(warmup_folds=3, open_after=2, close_after=2)
+    watch = SwarmWatch(cfg)
+
+    def fold(i, sps):
+        return {
+            "current_step": i,
+            "peers": [{"peer": "a", "step": i, "samples_per_second": sps}],
+        }
+
+    values = [100.0] * 4 + [45.0, 100.0, 45.0, 100.0, 45.0, 45.0,
+                            70.0, 100.0, 100.0]
+    for i, v in enumerate(values):
+        watch.observe_health(fold(i, v), t=float(i), step=i)
+    # oscillation never opened (no 2 consecutive bad folds) until the
+    # sustained dip; the 70.0 fold sits in the hysteresis band (neither
+    # good enough to close nor bad enough to re-open)
+    assert len(watch.incidents) == 1
+    (inc,) = watch.incidents
+    assert inc["metric"] == "samples_per_sec"
+    assert inc["status"] == "closed"
+
+
+def test_total_throughput_collapse_is_judged_not_skipped():
+    """An all-zero measured window is the WORST regression, not missing
+    data: once the swarm has ever reported throughput, zero must be
+    judged (−100%) — only never-reported first-fold placeholders skip."""
+    watch = SwarmWatch()
+
+    def fold(i, sps):
+        return {
+            "current_step": i,
+            "peers": [{"peer": "a", "step": i, "samples_per_second": sps}],
+        }
+
+    values = [0.0] + [100.0] * 4 + [0.0, 0.0, 0.0]
+    for i, v in enumerate(values):
+        watch.observe_health(fold(i, v), t=float(i), step=i)
+    (inc,) = watch.incidents
+    assert inc["metric"] == "samples_per_sec"
+    assert inc["observed"] == 0.0
+    assert inc["deviation"] == pytest.approx(-1.0)
+    assert inc["severity"] == "critical"
+
+
+# ------------------------------------------------------------- tools/CLI
+
+
+def test_swarm_watch_cli_one_shot_json_and_text(faulted_run, capsys):
+    rc = swarm_watch.main(["--json", faulted_run["coordinator_log"]])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["view"] == "watch"
+    assert len(doc["incidents"]) == len(
+        faulted_run["watch"]["incidents"]
+    )
+    rc = swarm_watch.main([faulted_run["coordinator_log"]])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "verdict:" in out
+    assert "incident timeline" in out
+    assert "link_degraded" in out and "churn_wave" in out
+    assert "trace=" in out and "phase=avg_wire" in out
+
+
+def test_swarm_watch_brief_tolerates_missing_files(tmp_path, capsys):
+    rc = swarm_watch.main([
+        "--brief", "--train-log", str(tmp_path / "absent.jsonl"),
+        str(tmp_path / "also_absent.jsonl"),
+    ])
+    assert rc == 0  # run_monitor.sh must keep rendering its screen
+
+
+def test_runlog_summary_incidents_view_json_text_and_recorded(
+    faulted_run, tmp_path, capsys
+):
+    runlog_summary.main(
+        ["--incidents", "--json", faulted_run["coordinator_log"]]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["view"] == "incidents" and doc["source"] == "replayed"
+    assert doc["folds"] == BASE_SPEC["avg_rounds"]
+
+    runlog_summary.main(["--incidents", faulted_run["coordinator_log"]])
+    out = capsys.readouterr().out
+    assert "incident timeline (replayed)" in out
+    assert "peer=peer-0005 phase=fwd_bwd" in out
+
+    # the coordinator's own incident JSONL renders as-is (last state wins)
+    incident = doc["incidents"][0]
+    log = tmp_path / "incidents.jsonl"
+    log.write_text(
+        json.dumps({"watch": "incident", "transition": "open",
+                    "incident": {**incident, "status": "open"}}) + "\n"
+        + json.dumps({"watch": "incident", "transition": "close",
+                      "incident": incident}) + "\n"
+    )
+    runlog_summary.main(["--incidents", "--json", str(log)])
+    doc2 = json.loads(capsys.readouterr().out)
+    assert doc2["source"] == "recorded"
+    assert len(doc2["incidents"]) == 1
+    assert doc2["incidents"][0]["status"] == incident["status"]
+
+
+def test_health_view_verdict_header_shared_with_watchdog(
+    faulted_run, capsys
+):
+    runlog_summary.main(["--health"] + list(faulted_run["event_logs"]))
+    out = capsys.readouterr().out
+    assert out.startswith("verdict: ")
+    assert ("OK" in out.splitlines()[0]) or (
+        "DEGRADED" in out.splitlines()[0]
+    )
+    runlog_summary.main(
+        ["--json", "--health"] + list(faulted_run["event_logs"])
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["verdict"]["status"] in ("OK", "DEGRADED")
+    assert "derived" in doc

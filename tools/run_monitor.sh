@@ -1,23 +1,19 @@
 #!/bin/bash
 # One-screen health check for a live hetero_converge.sh run:
 #   bash tools/run_monitor.sh /root/corpus/r5_converge
+#
+# The health logic lives in tools/swarm_watch.py --brief (the same
+# watchdog the coordinator runs inline and `runlog_summary --incidents`
+# replays): trainer cadence from the train log, the shared OK/DEGRADED
+# verdict plus any OPEN incidents from the coordinator metrics JSONL.
+# This script only assembles the screen.
 set -u
 RUN=${1:-/root/corpus/r5_converge}
+REPO=$(cd "$(dirname "$0")/.." && pwd)
 echo "=== $(date +%T) $RUN ==="
 tail -2 "$RUN/orchestrator.log" 2>/dev/null
-if [ -f "$RUN/train_log_tpu.jsonl" ]; then
-  python - "$RUN/train_log_tpu.jsonl" <<'EOF'
-import json, sys
-rows = [json.loads(x) for x in open(sys.argv[1]) if x.strip()]
-if rows:
-    r = rows[-1]
-    mins = r["wall_s"] / 60
-    print(f"tpu: step {r['step']}  loss {r['loss']:.3f}  wall {mins:.0f} min")
-    tail = [x for x in rows if x["wall_s"] >= r["wall_s"] - 600]
-    if len(tail) > 2:
-        per_min = (len(tail) - 1) / ((tail[-1]["wall_s"] - tail[0]["wall_s"]) / 60)
-        print(f"cadence (last 10 min): {per_min:.2f} steps/min")
-EOF
-fi
-PYTHONPATH=/root/repo python /root/repo/tools/participation_summary.py "$RUN" 2>/dev/null | python -c "import json,sys; d=json.load(sys.stdin); print({k: d[k] for k in d if 'particip' in k or k=='group_hist'})"
+PYTHONPATH="$REPO" python "$REPO/tools/swarm_watch.py" --brief \
+  --train-log "$RUN/train_log_tpu.jsonl" \
+  "$RUN/coordinator_metrics.jsonl" 2>/dev/null
+PYTHONPATH="$REPO" python "$REPO/tools/participation_summary.py" "$RUN" 2>/dev/null | python -c "import json,sys; d=json.load(sys.stdin); print({k: d[k] for k in d if 'particip' in k or k=='group_hist'})"
 pgrep -fc "dedloc_tpu.roles" | xargs echo "live role processes:"

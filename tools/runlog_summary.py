@@ -8,6 +8,7 @@ Usage:
     python tools/runlog_summary.py --topology events.jsonl [...]
     python tools/runlog_summary.py --steps events.jsonl [...]
     python tools/runlog_summary.py --twin events.jsonl [...]
+    python tools/runlog_summary.py --incidents coordinator_metrics.jsonl [...]
 
 Any view also accepts ``--json``: one machine-readable JSON document on
 stdout (schema: the ``*_data`` builders below, each tagged with a
@@ -49,6 +50,17 @@ samples-per-sec / overlap efficiency, per peer and swarm-wide, plus the
 worst-link ranking agreement and the fit-coverage summary. With ``--json``
 the machine-readable fidelity document is printed, so twin drift is itself
 monitorable.
+
+``--incidents`` renders the live watchdog's incident timeline
+(``dedloc_tpu/telemetry/watch.py``): given a coordinator metrics JSONL it
+REPLAYS the stream through the same watchdog the coordinator runs inline
+(deterministic — the replayed timeline is the live one); given the
+coordinator's incident JSONL it renders the recorded transitions as-is.
+Each incident shows severity, the metric that moved and by how much
+against its rolling baseline, open/close fold indices, and the
+attribution chain: offending peer and/or directed link, dominant step
+phase, and the representative slow round's trace id (feed it to
+``--trace``). Reading guide in docs/observability.md.
 
 ``--steps`` renders the step-phase flight recorder's view (per-step
 ``step.record`` / ``step.phase`` events from ``telemetry/steps.py``, or a
@@ -125,45 +137,27 @@ def percentiles(values):
 # an "event" key renders, unknown events just count toward totals.)
 
 
+def _repo_on_path():
+    """Make ``dedloc_tpu`` importable for the views that need it, exactly
+    once (this tool also runs standalone from outside the repo root)."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+
+
 def load_jsonl_rows(paths):
     """THE hardened JSONL loader every telemetry view (--health, --trace,
-    --topology) goes through. Tolerates the two corruptions real fleet logs
-    actually have:
+    --topology, --incidents) goes through: truncated final lines are
+    skipped, interleaved-writer lines split object-by-object. The ONE
+    implementation lives in ``dedloc_tpu/utils/jsonl.py`` — the
+    coordinator's self-retune read-back and the swarm_watch tail share it,
+    so tolerance rules cannot drift between live and post-hoc paths."""
+    _repo_on_path()
+    from dedloc_tpu.utils.jsonl import load_jsonl_rows as _load
 
-    - a truncated final line (the peer was killed mid-write — the very
-      churn these views exist to debug): the fragment is skipped;
-    - interleaved writers (two processes appending the same file can jam
-      two objects onto one line, or splice one object into another): each
-      line is decoded object-by-object with ``raw_decode``, salvaging every
-      complete object and counting only the garbage between them.
-
-    Returns all decoded dict rows in file order; callers filter."""
-    rows = []
-    dropped = 0
-    decoder = json.JSONDecoder()
-    for path in paths:
-        with open(path, encoding="utf-8", errors="replace") as f:
-            for line in f:
-                line = line.strip()
-                while line:
-                    start = line.find("{")
-                    if start < 0:
-                        dropped += 1  # no object on what remains
-                        break
-                    if start > 0:
-                        dropped += 1  # leading garbage before the object
-                    try:
-                        obj, end = decoder.raw_decode(line, start)
-                    except json.JSONDecodeError:
-                        dropped += 1  # truncated/spliced fragment
-                        break
-                    if isinstance(obj, dict):
-                        rows.append(obj)
-                    line = line[end:].strip()
-    if dropped:
-        print(f"warning: skipped {dropped} unparseable fragment(s)",
-              file=sys.stderr)
-    return rows
+    return _load(paths)
 
 
 def load_events(paths):
@@ -245,6 +239,42 @@ def _ckpt_failures(rows):
     return failures
 
 
+def _event_rates(rows):
+    """The watchdog's rule rates recomputed from raw event rows — the
+    --health input — so the verdict header evaluates the SAME thresholds
+    (telemetry/health.RULE_THRESHOLDS) the live watchdog applies to folded
+    records. Only the rates this input can support are produced; the rest
+    are skipped, never guessed."""
+    rates = {}
+    forms = [r for r in rows if r["event"] == "mm.form_group"]
+    if forms:
+        # form_group spans always stamp ok True/False, so from event logs
+        # "aborted" and "attempted but never formed" are the SAME set —
+        # one rate, not the same defect double-counted in the verdict
+        # (the fold-side derive_rates can tell them apart; events cannot)
+        rates["round_abort_rate"] = round(
+            sum(1 for r in forms if r.get("ok") is not True)
+            / len(forms), 4
+        )
+    lost = [r for r in rows if r["event"] == "rpc.conn_lost"]
+    ts = [r.get("t", 0.0) for r in rows]
+    span_min = (max(ts) - min(ts)) / 60.0 if len(ts) >= 2 else 0.0
+    if span_min > 0:
+        rates["conns_lost_per_min"] = round(len(lost) / span_min, 3)
+    return rates
+
+
+def _verdict_line(rows, rates=None):
+    """"verdict: OK/DEGRADED (reason)" via the shared rule set."""
+    _repo_on_path()
+    from dedloc_tpu.telemetry.health import verdict_from_rates
+
+    status, reason = verdict_from_rates(
+        _event_rates(rows) if rates is None else rates
+    )
+    return status, reason
+
+
 def health_data(rows):
     """The --health view as one JSON-able document."""
     if not rows:
@@ -259,8 +289,12 @@ def health_data(rows):
                 out[key] = r[key]
         return out
 
+    rates = _event_rates(rows)
+    status, reason = _verdict_line(rows, rates)
     return {
         "view": "health",
+        "verdict": {"status": status, "reason": reason},
+        "derived": rates,
         "events": len(rows),
         "rounds": [
             simplify(r, "round_id", "dur_s", "ok", "group_size")
@@ -300,6 +334,12 @@ def print_health(rows):
     if not rows:
         sys.exit("no telemetry events found (is --telemetry.enabled set?)")
     t0 = min(r.get("t", 0.0) for r in rows)
+
+    # the one-line verdict, from the SAME rule set the live watchdog runs
+    # (telemetry/health.RULE_THRESHOLDS): the post-hoc view and the
+    # watchdog cannot disagree about what counts as DEGRADED
+    status, reason = _verdict_line(rows)
+    print(f"verdict: {status} ({reason})")
 
     rounds = _health_rounds(rows)
     print("round timeline:")
@@ -1195,6 +1235,83 @@ def print_twin(all_rows, seed=0):
         )
 
 
+# --------------------------------------------------------- incidents view
+# (live-watchdog timeline: replay a coordinator metrics JSONL through the
+# same SwarmWatch the coordinator runs inline, or render a recorded
+# incident JSONL; imported lazily like the twin view)
+
+
+def incidents_data(all_rows):
+    """The --incidents view as one JSON-able document. Coordinator metrics
+    JSONL input is REPLAYED (deterministic: identical to the live run);
+    incident-JSONL input (the coordinator's own incident log) renders the
+    recorded transitions, last state per incident winning."""
+    has_health = any(
+        isinstance(r.get("swarm_health"), dict) for r in all_rows
+    )
+    if has_health:
+        _repo_on_path()
+        from dedloc_tpu.telemetry.watch import watch_rows
+
+        doc = watch_rows(all_rows).summary()
+        doc["view"] = "incidents"
+        doc["source"] = "replayed"
+        return doc
+    final = {}
+    for r in all_rows:
+        inc = r.get("incident")
+        if r.get("watch") == "incident" and isinstance(inc, dict):
+            final[inc.get("id", len(final))] = inc
+    if not final:
+        sys.exit(
+            "no swarm_health records and no watchdog incident records "
+            "found — feed a coordinator metrics JSONL or the "
+            "coordinator's incident JSONL"
+        )
+    ordered = sorted(
+        final.values(),
+        key=lambda i: (i.get("status") != "open", i.get("opened_fold", 0)),
+    )
+    return {
+        "view": "incidents",
+        "source": "recorded",
+        "incidents": ordered,
+        "open": sum(1 for i in ordered if i.get("status") == "open"),
+    }
+
+
+def print_incidents(all_rows):
+    doc = incidents_data(all_rows)
+    import os
+
+    # same-directory tool, loaded lazily; the explicit path keeps this
+    # working when runlog_summary itself was loaded from a file location
+    # (the test harness) rather than run as a script
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import swarm_watch as _sw
+
+    verdict = doc.get("verdict") or {}
+    if verdict:
+        print(f"verdict: {verdict.get('status')} ({verdict.get('reason')})")
+    folds = f" over {doc['folds']} fold(s)" if doc.get("folds") else ""
+    print(
+        f"incident timeline ({doc['source']}): {len(doc['incidents'])} "
+        f"incident(s), {doc['open']} open{folds}"
+    )
+    for inc in doc["incidents"]:
+        print(_sw.format_incident(inc))
+    if doc["incidents"]:
+        print(
+            "\nfollow an incident: runlog_summary --trace <round> over the "
+            "per-peer event logs resolves its representative trace; the "
+            "runbook is docs/fleet.md \"when the watchdog fires\""
+        )
+    for note in (doc.get("coverage") or {}).get("notes", []):
+        print(f"coverage note: {note}")
+
+
 def trainlog_data(rows, requested):
     """The default (train_log) view as one JSON-able document."""
     by_step = {r["step"]: r for r in rows}
@@ -1273,6 +1390,15 @@ def main(argv):
             emit(fid)
         else:
             print_twin(rows)
+        return
+    if argv and argv[0] == "--incidents":
+        if not argv[1:]:
+            sys.exit(
+                "usage: runlog_summary.py --incidents "
+                "coordinator_metrics.jsonl [...]"
+            )
+        rows = load_jsonl_rows(argv[1:])
+        emit(incidents_data(rows)) if as_json else print_incidents(rows)
         return
     rows = load(argv[0])
     if not rows:

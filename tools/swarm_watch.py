@@ -1,0 +1,318 @@
+"""Live swarm watchdog CLI: incident timeline over a coordinator JSONL.
+
+One-shot mode replays a coordinator metrics JSONL (the file
+``roles/coordinator.py`` appends, or a simulator watchdog scenario's
+``coordinator.jsonl``) through the streaming watchdog
+(``dedloc_tpu/telemetry/watch.py``) and prints the incident timeline —
+every incident with its severity, open/close folds, the metric that moved
+and by how much, and the attribution chain (peer / directed link / step
+phase / representative trace id). ``--follow`` tails the same file live,
+printing incidents as they open and close: the one-screen "is my fleet
+okay" view, sharing ONE implementation with the coordinator's inline
+watchdog and with ``runlog_summary --incidents`` — a replay of the dumped
+JSONL reproduces the live timeline exactly.
+
+Usage::
+
+    # one-shot timeline (text, or --json for one machine-readable doc)
+    python tools/swarm_watch.py coordinator_metrics.jsonl
+    python tools/swarm_watch.py --json coordinator_metrics.jsonl
+
+    # live tail (Ctrl-C for the closing summary)
+    python tools/swarm_watch.py --follow --interval 5 coordinator_metrics.jsonl
+
+    # attach twin-backed retuning recommendations to eligible incidents
+    # (fits a TwinModel from the given logs; recommendation only)
+    python tools/swarm_watch.py --recommend coordinator.jsonl peer-*.jsonl
+
+    # compact one-screen health check (tools/run_monitor.sh delegates
+    # here); missing files are skipped, not fatal
+    python tools/swarm_watch.py --brief --train-log train_log.jsonl \
+        coordinator_metrics.jsonl
+
+Input tolerance: everything loads through the shared hardened JSONL
+loader (``runlog_summary.load_jsonl_rows``) — jammed lines are split,
+truncated tails skipped, and health records missing whole telemetry
+generations (pre-link, pre-step-recorder) degrade into the watchdog's
+REPORTED coverage summary instead of crashing or fabricating incidents.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from runlog_summary import load_jsonl_rows  # noqa: E402
+
+
+def _fmt_value(metric: str, value) -> str:
+    if value is None:
+        return "-"
+    v = float(value)
+    if "goodput" in metric or "uplink" in metric:
+        if v >= 1e6:
+            return f"{v / 1e6:.1f}MB/s"
+        if v >= 1e3:
+            return f"{v / 1e3:.1f}KB/s"
+        return f"{v:.0f}B/s"
+    if metric.endswith("_s") or "wall" in metric or "rtt" in metric \
+            or "phase" in metric or "formation" in metric:
+        return f"{v:.3f}s"
+    return f"{v:.4g}"
+
+
+def format_incident(inc: dict) -> str:
+    """One incident as one (long) line: everything a responder needs to
+    start the runbook (docs/fleet.md "when the watchdog fires")."""
+    dev = inc.get("deviation")
+    dev_s = f" ({dev * 100.0:+.0f}%)" if dev is not None else ""
+    head = (
+        f"[{inc['id']}] {inc['severity'].upper():<8} {inc['kind']:<16} "
+        f"{inc['subject']}: {inc['metric']} "
+        f"{_fmt_value(inc['metric'], inc.get('observed'))} vs baseline "
+        f"{_fmt_value(inc['metric'], inc.get('baseline'))}{dev_s}"
+    )
+    where = []
+    if inc.get("peer"):
+        where.append(f"peer={inc['peer']}")
+    if inc.get("link"):
+        where.append(f"link={inc['link']['src']}->{inc['link']['dst']}")
+    if inc.get("phase"):
+        where.append(f"phase={inc['phase']}")
+    if inc.get("peers_lost"):
+        where.append(f"lost={inc['peers_lost']}")
+    if inc.get("round_id"):
+        where.append(f"round={inc['round_id']}")
+    if inc.get("trace"):
+        where.append(f"trace={inc['trace']}")
+    span = f"opened fold {inc['opened_fold']}"
+    if inc.get("opened_step") is not None:
+        span += f" (step {inc['opened_step']})"
+    span += (
+        f", closed fold {inc['closed_fold']}"
+        if inc.get("closed_fold") is not None else ", still OPEN"
+    )
+    lines = [head, f"    {' '.join(where)}" if where else None, f"    {span}"]
+    if inc.get("effects"):
+        effects = ", ".join(
+            f"{e['metric']}"
+            + (f" {e['deviation'] * 100.0:+.0f}%"
+               if e.get("deviation") is not None else "")
+            for e in inc["effects"]
+        )
+        lines.append(f"    effects: {effects}")
+    rec = inc.get("recommendation")
+    if rec:
+        lo, hi = rec["interval"]
+        lines.append(
+            f"    twin recommends: {json.dumps(rec['config'])} — predicted "
+            f"{rec['predicted_samples_per_sec']:.1f} samples/sec "
+            f"[{lo:.1f}, {hi:.1f}] "
+            f"(fidelity ±{rec['fidelity_bound'] * 100.0:.0f}%)"
+        )
+    elif inc.get("recommendation_reason"):
+        lines.append(
+            f"    no recommendation: {inc['recommendation_reason']}"
+        )
+    return "\n".join(line for line in lines if line)
+
+
+def print_watch(summary: dict, brief: bool = False) -> None:
+    verdict = summary.get("verdict") or {}
+    print(
+        f"verdict: {verdict.get('status', '?')} "
+        f"({verdict.get('reason', 'no health records seen')}) — "
+        f"{summary['folds']} fold(s), "
+        f"{len(summary['incidents'])} incident(s), {summary['open']} open"
+    )
+    if brief:
+        for inc in summary["incidents"]:
+            if inc["status"] == "open":
+                print(format_incident(inc).splitlines()[0])
+        return
+    if summary["incidents"]:
+        print("\nincident timeline (open first):")
+        for inc in summary["incidents"]:
+            print(format_incident(inc))
+    else:
+        print("no incidents")
+    cov = summary["coverage"]
+    print(
+        f"\ncoverage: {cov['folds']} folds · topology in "
+        f"{cov['folds_with_topology']} · phases in "
+        f"{cov['folds_with_phases']} · round summaries in "
+        f"{cov['folds_with_rounds']} · up to {cov['peers_seen']} peer(s)"
+    )
+    for note in cov.get("notes", []):
+        print(f"coverage note: {note}")
+
+
+def train_log_brief(path: str) -> None:
+    """The last-step/cadence lines tools/run_monitor.sh used to compute
+    with inline python — now one implementation, shared."""
+    try:
+        rows = [
+            r for r in load_jsonl_rows([path])
+            if "step" in r and "loss" in r and "wall_s" in r
+        ]
+    except OSError:
+        return
+    if not rows:
+        return
+    last = rows[-1]
+    print(
+        f"tpu: step {last['step']}  loss {last['loss']:.3f}  "
+        f"wall {last['wall_s'] / 60:.0f} min"
+    )
+    tail = [r for r in rows if r["wall_s"] >= last["wall_s"] - 600]
+    if len(tail) > 2 and tail[-1]["wall_s"] > tail[0]["wall_s"]:
+        per_min = (len(tail) - 1) / (
+            (tail[-1]["wall_s"] - tail[0]["wall_s"]) / 60
+        )
+        print(f"cadence (last 10 min): {per_min:.2f} steps/min")
+
+
+def _attach_recommendations(watch, rows, seed: int) -> None:
+    from dedloc_tpu.telemetry.watch import attach_recommendation
+
+    for inc in watch.incidents:
+        if inc.get("retune_eligible"):
+            attach_recommendation(inc, rows, seed=seed)
+
+
+def follow(paths, interval: float, config=None) -> int:
+    """Tail the JSONL(s), feeding new swarm_health rows into one live
+    watchdog and printing transitions as they happen."""
+    from dedloc_tpu.telemetry.watch import SwarmWatch
+    from dedloc_tpu.utils.jsonl import iter_line_objects
+
+    watch = SwarmWatch(config)
+    offsets = {p: 0 for p in paths}
+    buffers = {p: "" for p in paths}
+
+    def feed_line(line: str) -> None:
+        # the SAME object-salvaging rules as the one-shot loader
+        # (utils/jsonl.py): jammed lines split, torn fragments dropped
+        objs, _dropped = iter_line_objects(line)
+        for obj in objs:
+            health = obj.get("swarm_health")
+            if not isinstance(health, dict):
+                continue
+            t = obj.get("time")
+            for tr in watch.observe_health(
+                health,
+                t=float(t) if t is not None else None,
+                step=obj.get("step"),
+                samples_per_sec=obj.get("samples_per_second"),
+            ):
+                inc = tr["incident"]
+                stamp = time.strftime("%H:%M:%S")
+                print(f"[{stamp}] {tr['transition'].upper()}:")
+                print(format_incident(inc))
+
+    print(f"watching {len(paths)} file(s); Ctrl-C for the summary")
+    try:
+        while True:
+            for p in paths:
+                try:
+                    size = os.path.getsize(p)
+                except OSError:
+                    continue
+                if size < offsets[p]:  # rotated / truncated underneath us
+                    offsets[p] = 0
+                    buffers[p] = ""
+                if size > offsets[p]:
+                    with open(p, encoding="utf-8", errors="replace") as f:
+                        f.seek(offsets[p])
+                        buffers[p] += f.read()
+                        offsets[p] = f.tell()
+                    *lines, buffers[p] = buffers[p].split("\n")
+                    for line in lines:
+                        feed_line(line)
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        print()
+        print_watch(watch.summary())
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+    )
+    parser.add_argument("logs", nargs="*",
+                        help="coordinator metrics JSONL(s); with "
+                             "--recommend, per-peer event logs help the "
+                             "twin fit too")
+    parser.add_argument("--json", action="store_true",
+                        help="one machine-readable watch document")
+    parser.add_argument("--follow", action="store_true",
+                        help="tail the file(s) live instead of one-shot")
+    parser.add_argument("--interval", type=float, default=5.0,
+                        help="--follow poll period, seconds")
+    parser.add_argument("--recommend", action="store_true",
+                        help="attach twin-backed retuning recommendations "
+                             "to retune-eligible incidents (bounded sweep; "
+                             "recommendation only, nothing is applied)")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="twin replay seed for --recommend")
+    parser.add_argument("--brief", action="store_true",
+                        help="compact one-screen output (run_monitor.sh); "
+                             "missing files are skipped, not fatal")
+    parser.add_argument("--train-log",
+                        help="also print the trainer-log brief (last step, "
+                             "loss, cadence) from this JSONL")
+    args = parser.parse_args(argv)
+
+    if args.train_log and (args.brief or not args.follow):
+        if os.path.exists(args.train_log):
+            train_log_brief(args.train_log)
+        elif not args.brief:
+            print(f"warning: no train log at {args.train_log}",
+                  file=sys.stderr)
+
+    if args.follow:
+        if not args.logs:
+            parser.error("give at least one coordinator metrics JSONL")
+        # a not-yet-created file is fine in follow mode: the tail waits
+        return follow(list(args.logs), args.interval)
+
+    missing = [p for p in args.logs if not os.path.exists(p)]
+    if args.brief:
+        paths = [p for p in args.logs if os.path.exists(p)]
+        if not paths:
+            return 0  # a run dir with no coordinator log yet: stay quiet
+    else:
+        if missing:
+            parser.error(f"no such file: {missing[0]}")
+        paths = list(args.logs)
+        if not paths:
+            parser.error("give at least one coordinator metrics JSONL")
+
+    from dedloc_tpu.telemetry.watch import watch_rows
+
+    rows = load_jsonl_rows(paths)
+    watch = watch_rows(rows)
+    if watch.coverage["folds"] == 0 and not args.brief:
+        sys.exit(
+            "no swarm_health records in the given file(s) — is this a "
+            "coordinator metrics JSONL? (per-peer event logs feed "
+            "runlog_summary --health/--steps instead)"
+        )
+    if args.recommend:
+        _attach_recommendations(watch, rows, args.seed)
+    summary = watch.summary()
+    if args.json:
+        print(json.dumps(summary, indent=1, default=str))
+    else:
+        print_watch(summary, brief=args.brief)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
