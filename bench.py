@@ -300,6 +300,109 @@ def run_allreduce_pipeline() -> None:
     }))
 
 
+def run_grad_pipeline() -> None:
+    """Boundary-seam bench (DEDLOC_BENCH=grad_pipeline): the gradient
+    device->host seam at an averaging boundary — legacy per-leaf
+    ``device_get`` + host ``TreeLayout.flatten_into`` vs the device-resident
+    flat pipeline (``averaging/device_flat.py``: fused on-device
+    flatten+mean+quantize, chunked async D2H, decode-only host leg) — over
+    the ALBERT-large gradient tree (~17.9M fp32 params, the tree a peer
+    actually ships per round).
+
+    Reports (a) D2H bytes per boundary for each path (deterministic — the
+    tier-1 contract half; under fp16/uint8 wire formats the pipeline moves
+    2-4x fewer bytes because quantization happens BEFORE the transfer) and
+    (b) best-of wall to contribution-ready on the host
+    (DEDLOC_BENCH_TIMING=0 skips). vs_baseline is legacy wall / pipeline
+    wall — meaningful on a real PCIe/tunnel link where bytes dominate; on
+    a CPU backend both paths are memcpy-bound and the ratio hovers near 1.
+    ``DEDLOC_BENCH_COMPRESSION`` picks the wire format (default float16).
+    """
+    import jax.numpy as jnp
+
+    from dedloc_tpu.averaging.device_flat import DeviceFlatPipeline
+    from dedloc_tpu.averaging.partition import TreeLayout
+    from dedloc_tpu.collaborative.optimizer import _tree_to_named
+
+    tiny = os.environ.get("DEDLOC_BENCH_TINY", "") == "1"
+    timing = os.environ.get("DEDLOC_BENCH_TIMING", "1") != "0"
+    compression = os.environ.get("DEDLOC_BENCH_COMPRESSION", "float16")
+    rng = np.random.default_rng(0)
+    scale = 0.01 if tiny else 1.0
+
+    def t(*shape):
+        shape = tuple(max(1, int(d * scale)) for d in shape)
+        return jnp.asarray(
+            rng.standard_normal(shape).astype(np.float32)
+        )
+
+    # the ALBERT-large gradient tree shape (run_codec's tree, as grads)
+    tree = {
+        "word_embeddings": t(30000, 128),
+        "position_embeddings": t(512, 128),
+        "token_type_embeddings": t(2, 128),
+        "embedding_projection": t(128, 1024),
+        "attn_qkv": t(3, 1024, 1024) if not tiny else t(3, 32, 32),
+        "attn_out": t(1024, 1024),
+        "ffn_in": t(1024, 4096),
+        "ffn_out": t(4096, 1024),
+        "pooler": t(1024, 1024),
+        "mlm_dense": t(1024, 128),
+        "mlm_bias": t(30000),
+    }
+    n_micro = 16
+    n_params = sum(int(v.size) for v in jax.tree.leaves(tree))
+
+    def legacy_boundary():
+        mean = jax.tree.map(lambda g: g / n_micro, tree)
+        named = _tree_to_named(mean)  # per-leaf device_get
+        layout = TreeLayout.for_tree(named)
+        return layout.flatten_into(named)
+
+    pipe = DeviceFlatPipeline.for_tree(tree, compression=compression)
+
+    def pipeline_boundary():
+        fetch = pipe.fetch(tree, n=n_micro, use_ef=False)
+        return fetch, fetch.result().flat
+
+    # warm both paths (jit compile, buffer alloc)
+    legacy_flat = legacy_boundary()
+    fetch, pipe_flat = pipeline_boundary()
+    np.testing.assert_allclose(pipe_flat, legacy_flat, atol=1e-2)
+    legacy_bytes = n_params * 4  # fp32 over the seam, per-leaf
+    pipeline_bytes = fetch.wire_bytes
+
+    legacy_wall = pipe_wall = float("inf")
+    iters = 1 if tiny else 3
+    if timing:
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            legacy_boundary()
+            legacy_wall = min(legacy_wall, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            pipeline_boundary()
+            pipe_wall = min(pipe_wall, time.perf_counter() - t0)
+
+    print(json.dumps({
+        "metric": "grad_pipeline_d2h_bytes_per_boundary",
+        "value": pipeline_bytes,
+        "unit": "bytes",
+        # byte reduction is the load-bearing, hardware-independent number;
+        # the wall ratio below only speaks on a real device link
+        "vs_baseline": round(legacy_bytes / pipeline_bytes, 3),
+        "compression": compression,
+        "n_params": n_params,
+        "legacy_d2h_bytes": legacy_bytes,
+        "legacy_wall_ms": (
+            round(legacy_wall * 1e3, 2) if timing else 0.0
+        ),
+        "pipeline_wall_ms": (
+            round(pipe_wall * 1e3, 2) if timing else 0.0
+        ),
+        "chunks": len(pipe.bounds),
+    }))
+
+
 def run_checkpoint_restore() -> None:
     """Swarm-checkpoint restore bench (DEDLOC_BENCH=checkpoint_restore):
     bootstrap bytes + wall for a joiner restoring the collaboration state,
@@ -661,6 +764,9 @@ def main() -> None:
         return
     if os.environ.get("DEDLOC_BENCH") == "allreduce_pipeline":
         run_allreduce_pipeline()
+        return
+    if os.environ.get("DEDLOC_BENCH") == "grad_pipeline":
+        run_grad_pipeline()
         return
     if os.environ.get("DEDLOC_BENCH") == "checkpoint_restore":
         run_checkpoint_restore()

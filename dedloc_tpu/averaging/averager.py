@@ -28,7 +28,7 @@ from dedloc_tpu.averaging.matchmaking import (
     Matchmaking,
     MatchmakingFailed,
 )
-from dedloc_tpu.averaging.partition import TreeLayout
+from dedloc_tpu.averaging.partition import FlatTree, TreeLayout
 from dedloc_tpu.checkpointing import (
     CheckpointAnnouncement,
     CheckpointManifest,
@@ -68,6 +68,19 @@ def schema_fingerprint(tree: Dict[str, np.ndarray]) -> bytes:
         h.update(name.encode())
         h.update(str(tuple(arr.shape)).encode())
         h.update(str(arr.dtype).encode())
+    return h.digest()[:16]
+
+
+def spec_fingerprint(spec) -> bytes:
+    """``schema_fingerprint`` computed from a TreeLayout spec alone — the
+    same digest a named-dict view of the buffer would produce, so a peer
+    contributing through the device-flat pipeline (``FlatFetch``) can join
+    matchmaking BEFORE its device->host transfer has resolved."""
+    h = hashlib.sha256()
+    for name, shape, dtype in sorted(spec, key=lambda entry: entry[0]):
+        h.update(name.encode())
+        h.update(str(tuple(shape)).encode())
+        h.update(str(np.dtype(dtype)).encode())
     return h.digest()[:16]
 
 
@@ -446,6 +459,13 @@ class DecentralizedAverager:
     ):
         """Average ``tree`` with whatever group forms for ``round_id``.
 
+        ``tree`` is a {name: array} mapping — or a ``FlatFetch`` from the
+        device-flat pipeline (``averaging/device_flat.py``), whose D2H
+        transfer is then resolved on an executor thread CONCURRENTLY with
+        matchmaking. Successful rounds return a ``FlatTree`` (a dict whose
+        values view one flat buffer), so flat-native callers skip the
+        re-flatten.
+
         Returns (averaged_tree | None, group_size); None means the round
         failed and the caller should proceed with its local values
         (reference semantics: a failed group costs one round, nothing else).
@@ -504,31 +524,66 @@ class DecentralizedAverager:
             return averaged, group_size
 
     async def _step_inner(
-        self, tree: Dict[str, np.ndarray], weight: float, round_id: str,
+        self, tree, weight: float, round_id: str,
         expected_size: Optional[int] = None,
         window: Optional[float] = None,
     ) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
+        # device-flat contribution (averaging/device_flat.py FlatFetch):
+        # the flat buffer is still streaming off the accelerator — resolve
+        # it on an executor thread CONCURRENTLY with matchmaking, so the
+        # D2H transfer hides behind group formation instead of preceding it
+        from dedloc_tpu.averaging.device_flat import FlatFetch
+
+        fetch = None
+        if isinstance(tree, FlatFetch):
+            fetch = tree
+            tree = None
+            loop = asyncio.get_running_loop()
+            resolve_task = loop.run_in_executor(None, fetch.result)
         try:
             group = await self.matchmaking.form_group(
-                round_id, schema=schema_fingerprint(tree),
+                round_id,
+                schema=(
+                    spec_fingerprint(fetch.spec) if fetch is not None
+                    else schema_fingerprint(tree)
+                ),
                 expected_size=expected_size, window=window,
             )
         except MatchmakingFailed as e:
             logger.debug(f"matchmaking failed for {round_id}: {e}")
             self.last_contributors = 0
+            if fetch is not None:
+                # settle the in-flight transfer even on failure: the
+                # pipeline's double buffer rotates on the NEXT fetch, so an
+                # unresolved transfer must not be left dangling
+                await resolve_task
             return None, 1
+        if fetch is not None:
+            try:
+                tree = await resolve_task
+            except Exception as e:  # noqa: BLE001 — a failed D2H/decode
+                # costs one round, never the training process
+                logger.warning(f"{round_id}: device-flat fetch failed: {e!r}")
+                self.last_contributors = 0
+                return None, 1
         self.last_group_size = len(group.members)
         # gradient-bearing member count for the caller's divergence guard:
         # a {trainer, aux} group averages nothing for the trainer
         self.last_contributors = group.contributors
         if len(group.members) == 1:
             return (tree if weight > 0 else None), 1
-        if self._layout is None or not self._layout.matches(tree):
-            self._layout = TreeLayout.for_tree(tree)
-        # flatten into the layout's reused buffer: no astype/concatenate
-        # temporaries on the hot path (valid until the next round's flatten —
-        # the all-reduce reads it only within run())
-        flat = self._layout.flatten_into(tree)
+        if isinstance(tree, FlatTree):
+            # already flat in layout order: skip the host re-flatten pass
+            if self._layout is None or self._layout.spec != tree.spec:
+                self._layout = TreeLayout(tree.spec)
+            flat = tree.flat
+        else:
+            if self._layout is None or not self._layout.matches(tree):
+                self._layout = TreeLayout.for_tree(tree)
+            # flatten into the layout's reused buffer: no astype/concatenate
+            # temporaries on the hot path (valid until the next round's
+            # flatten — the all-reduce reads it only within run())
+            flat = self._layout.flatten_into(tree)
         try:
             # the nonce is fresh per group assembly, so a retried round never
             # collides with _RoundState left over from a failed attempt
@@ -547,7 +602,10 @@ class DecentralizedAverager:
         except AllreduceFailed as e:
             logger.warning(f"allreduce failed for {round_id}: {e}")
             return None, len(group.members)
-        return self._layout.unflatten(averaged), len(group.members)
+        # a FlatTree result: the named views every existing consumer reads,
+        # plus the flat buffer itself so a flat-native caller (the fused
+        # flat apply) device_puts ONE array instead of per-leaf pieces
+        return self._layout.tree_view(averaged), len(group.members)
 
     # --------------------------------------------------------- state sharing
 

@@ -83,6 +83,33 @@ class TreeLayout:
     def unflatten(self, flat: np.ndarray) -> Dict[str, np.ndarray]:
         return unflatten_tree(flat, self.spec)
 
+    def tree_view(self, flat: np.ndarray) -> "FlatTree":
+        """A ``FlatTree`` over ``flat``: the named-dict view of the buffer
+        that ALSO carries the buffer itself, so flat-aware consumers (the
+        averager's wire path, the fused flat apply) skip the re-flatten."""
+        assert flat.size == self.total_size, "buffer does not match layout"
+        return FlatTree(self.unflatten(flat), flat=flat, spec=self.spec)
+
+
+class FlatTree(dict):
+    """A {name: array} gradient tree whose values are VIEWS of one flat
+    fp32 buffer in TreeLayout (sorted-name) order.
+
+    Behaves exactly like the plain dict the averaging stack has always
+    consumed — ``schema_fingerprint``, serialization, and stubbed tests
+    all see a normal mapping — but carries ``.flat`` (the backing buffer)
+    and ``.spec`` so flat-native consumers avoid re-flattening what is
+    already flat. The buffer may be reused by its producer (double-buffered
+    device fetches): treat it as valid only until the producing pipeline's
+    next-but-one fetch, the same lifetime contract as
+    ``TreeLayout.flatten_into``.
+    """
+
+    def __init__(self, mapping, flat: np.ndarray, spec):
+        super().__init__(mapping)
+        self.flat = flat
+        self.spec = list(spec)
+
 
 def flatten_tree(tree: Dict[str, np.ndarray]) -> Tuple[np.ndarray, List[Tuple[str, Tuple[int, ...], np.dtype]]]:
     """Flatten {name: array} into one fp32 vector + layout spec (sorted by name

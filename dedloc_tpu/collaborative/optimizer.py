@@ -30,6 +30,8 @@ import optax
 
 from dedloc_tpu.averaging.allreduce import DEFAULT_CHUNK_SIZE
 from dedloc_tpu.averaging.averager import DecentralizedAverager
+from dedloc_tpu.averaging.device_flat import DeviceFlatPipeline
+from dedloc_tpu.averaging.partition import FlatTree
 from dedloc_tpu.collaborative.error_feedback import ErrorFeedback
 from dedloc_tpu.collaborative.progress import (
     CollaborationState,
@@ -43,8 +45,8 @@ from dedloc_tpu.telemetry import steps
 from dedloc_tpu.telemetry.registry import monotonic_clock
 from dedloc_tpu.parallel.train_step import (
     TrainState,
-    make_apply_step,
-    params_are_finite,
+    make_flat_apply_step,
+    make_guarded_apply_step,
     zeros_like_grads,
 )
 from dedloc_tpu.utils.logging import get_logger
@@ -73,6 +75,26 @@ def _named_to_tree(named: Dict[str, np.ndarray], like):
     return jax.tree_util.tree_unflatten(
         jax.tree_util.tree_structure(like), leaves
     )
+
+
+@jax.jit
+def _fused_mean_clip(grad_acc, n, cap):
+    """The accumulator mean plus the contribution clip as ONE fused jitted
+    program: ``grad_acc / n`` per leaf, one global-norm reduce, one scale.
+    ``cap <= 0`` disables the clip (the scale multiplies by exactly 1.0, a
+    bitwise no-op). Replaces the Python-level sum of per-leaf ``vdot``s
+    that used to emit O(leaves) tiny kernels per boundary."""
+    mean = jax.tree.map(lambda g: g / n, grad_acc)
+    gnorm = jax.numpy.sqrt(
+        sum(
+            jax.numpy.vdot(g, g).real
+            for g in jax.tree.leaves(mean)
+        )
+    )
+    scale = jax.numpy.where(
+        cap > 0, jax.numpy.minimum(1.0, cap / (gnorm + 1e-12)), 1.0
+    )
+    return jax.tree.map(lambda g: g * scale, mean)
 
 
 class CollaborativeOptimizer:
@@ -159,6 +181,19 @@ class CollaborativeOptimizer:
         telemetry_registry=None,  # per-peer telemetry scope, forwarded to
         # the averager/matchmaking/RPC stack (telemetry/registry.py); None
         # falls back to the process-global registry at each site
+        device_flat: bool = True,  # device-resident flat gradient pipeline
+        # (averaging/device_flat.py): the boundary's mean/clip/error-
+        # feedback/quantize all run in one fused jit on the accelerator and
+        # the compressed representation streams to the host in async chunks
+        # — the grad_flatten phase transfers 2-4x fewer PCIe bytes under a
+        # lossy wire format and the host codec becomes decode-only. Falls
+        # back to the legacy per-leaf host path automatically when the
+        # gradient tree is refused (non-float leaves).
+        flat_opt_factory: Optional[Callable] = None,  # (spec, params) ->
+        # optim.flat.FlatLamb/FlatLars: enables the fused FLAT apply — the
+        # averaged result device_puts as ONE buffer and the whole optimizer
+        # update runs as segment reductions over it (make_flat_apply_step).
+        # None (or any sharded layout) keeps the per-leaf guarded apply.
     ):
         assert not (client_mode and auxiliary), "an auxiliary peer must listen"
         self.tx = tx
@@ -255,14 +290,32 @@ class CollaborativeOptimizer:
         self.mesh = mesh
         self.opt_state_sharding = opt_state_sharding
         self.param_sharding = param_sharding
-        self._apply_fn = make_apply_step(
-            tx, mesh=mesh, opt_state_sharding=opt_state_sharding,
-            param_sharding=param_sharding,
-        )
         # post-update transform on the new state (e.g. SwAV prototype
         # re-normalization — NormalizePrototypesHook.on_update capability,
-        # swav_hooks.py:55-92); runs once per GLOBAL step inside jit
+        # swav_hooks.py:55-92); runs once per GLOBAL step inside the SAME
+        # jit as the apply and its NaN guard
         self.post_apply = post_apply
+        # guarded apply: optimizer update + post_apply + fused all-finite
+        # reduce + jnp.where rollback in ONE jitted program — no pre-apply
+        # HBM copy of (step, params, opt_state), no host-synced finite
+        # check; the ok flag is read one boundary later (_check_apply_ok)
+        self._apply_fn = make_guarded_apply_step(
+            tx, mesh=mesh, opt_state_sharding=opt_state_sharding,
+            param_sharding=param_sharding, post_apply=post_apply,
+        )
+        # device-resident flat gradient pipeline (built lazily from the
+        # first boundary's gradient tree; see the constructor docstring)
+        self.device_flat = bool(device_flat)
+        self.flat_opt_factory = flat_opt_factory
+        self._pipeline: Optional[DeviceFlatPipeline] = None
+        self._flat_apply_fn = None
+        self._flat_apply_spec = None
+        self._flat_apply_failed = False
+        # (round_id, device ok scalar) of the most recent guarded apply:
+        # fetched lazily at the NEXT boundary so the NaN verdict never
+        # stalls the dispatch stream (the legacy host-synced check cost a
+        # full device round-trip per global step)
+        self._pending_apply_ok: Optional[Tuple[str, Any]] = None
         self._lock = threading.Lock()
         # the state backup (device_get of params+opt_state) runs on this
         # thread, OFF the critical path: it is read-only w.r.t. the next
@@ -524,27 +577,20 @@ class CollaborativeOptimizer:
         """Average gradients with the group and apply one optimizer update."""
         round_id = f"step{collab.optimizer_step}"
         n = max(int(jax.device_get(n_acc)), 1)
-        mean_grads = jax.tree.map(lambda g: g / n, grad_acc)
+        # contribution cap: sample-weighted averaging assumes equal
+        # per-sample gradient quality, so the cap scales with OUR samples
+        # per MICRO-batch (the contribution is grad_acc/n_acc, a
+        # per-micro-batch mean) — it self-calibrates across peer batch
+        # sizes, never binds a healthy peer, and suppresses the tiny-batch
+        # sinkhorn-noise outlier (measured 19x per-sample energy at B=2;
+        # see core/config.py). The mean division, the global-norm reduce
+        # and the scale all run as ONE fused device program — either
+        # inside the flat pipeline's prepare or via _fused_mean_clip.
+        cap = 0.0
         if self.contrib_clip_per_sample > 0:
-            # cap what we contribute to the round: sample-weighted averaging
-            # assumes equal per-sample gradient quality, so the cap scales
-            # with OUR samples per MICRO-batch (mean_grads = grad_acc/n_acc
-            # is a per-micro-batch mean) — it self-calibrates across peer
-            # batch sizes, never binds a healthy peer, and suppresses the
-            # tiny-batch sinkhorn-noise outlier (measured 19x per-sample
-            # energy at B=2; see core/config.py). Runs on device: one
-            # global-norm reduce + scale, ~free next to the grad device_get.
             cap = self.contrib_clip_per_sample * max(
                 float(self.local_samples_accumulated) / n, 1.0
             )
-            gnorm = jax.numpy.sqrt(
-                sum(
-                    jax.numpy.vdot(g, g).real
-                    for g in jax.tree.leaves(mean_grads)
-                )
-            )
-            scale = jax.numpy.minimum(1.0, cap / (gnorm + 1e-12))
-            mean_grads = jax.tree.map(lambda g: g * scale, mean_grads)
 
         alone_grace = (
             get_dht_time() - self._created_at
@@ -602,28 +648,65 @@ class CollaborativeOptimizer:
             # lets a concurrent starter pair with us.
             self.seam_ms.pop("grads_device_get", None)
             return self._apply_and_advance(
-                state, mean_grads, collab, group_size=1
+                state, _fused_mean_clip(grad_acc, n, cap), collab,
+                group_size=1,
             )
 
-        t0 = time.perf_counter()
-        with steps.phase("grad_flatten"):
-            # device_get of the full grad tree (the jit↔host seam)
-            named = _tree_to_named(mean_grads)
-        self.seam_ms["grads_device_get"] = (time.perf_counter() - t0) * 1e3
-
-        # error feedback (collaborative/error_feedback.py): fold the last
-        # round's quantization residual into this round's contribution so a
-        # lossy wire format doesn't bias the trunk. Committed only when the
-        # round actually lands — a retried round re-derives the same
-        # contribution instead of compounding the residual.
-        if weight_scale > 0 and self.error_feedback.enabled:
-            contrib, ef_commit = self.error_feedback.prepare(named)
-            if tele is not None:
+        pipeline = self._ensure_pipeline(grad_acc)
+        lossy_d2h = False
+        fetch = None
+        if pipeline is not None:
+            # device-resident seam: ONE fused program computes the mean,
+            # the clip reduce, the error-feedback fold and (under a lossy
+            # wire format) the quantization, then streams the compressed
+            # buffer to the host in async chunks. The boundary only pays
+            # the program LAUNCH here — the transfer itself resolves
+            # inside the averaging round, overlapped with matchmaking (and
+            # with the next micro-batches' accumulation in overlap mode).
+            use_ef = weight_scale > 0 and self.error_feedback.enabled
+            t0 = time.perf_counter()
+            with steps.phase("grad_flatten"):
+                fetch = pipeline.fetch(
+                    grad_acc, n=n, clip_cap=cap if cap > 0 else None,
+                    use_ef=use_ef,
+                )
+            self.seam_ms["grads_device_get"] = (
+                (time.perf_counter() - t0) * 1e3
+            )
+            contrib = fetch
+            ef_commit = (
+                (lambda: pipeline.commit(fetch)) if use_ef else None
+            )
+            lossy_d2h = pipeline.ef_enabled
+            if use_ef and tele is not None:
                 tele.gauge("opt.ef_residual_norm").set(
-                    self.error_feedback.residual_norm()
+                    pipeline.residual_norm()
                 )
         else:
-            contrib, ef_commit = named, None
+            # legacy host seam (non-float leaves refused the pipeline):
+            # per-leaf device_get + host flatten + host error feedback
+            mean_grads = _fused_mean_clip(grad_acc, n, cap)
+            t0 = time.perf_counter()
+            with steps.phase("grad_flatten"):
+                # device_get of the full grad tree (the jit↔host seam)
+                named = _tree_to_named(mean_grads)
+            self.seam_ms["grads_device_get"] = (
+                (time.perf_counter() - t0) * 1e3
+            )
+            # error feedback (collaborative/error_feedback.py): fold the
+            # last round's quantization residual into this round's
+            # contribution so a lossy wire format doesn't bias the trunk.
+            # Committed only when the round actually lands — a retried
+            # round re-derives the same contribution instead of
+            # compounding the residual.
+            if weight_scale > 0 and self.error_feedback.enabled:
+                contrib, ef_commit = self.error_feedback.prepare(named)
+                if tele is not None:
+                    tele.gauge("opt.ef_residual_norm").set(
+                        self.error_feedback.residual_norm()
+                    )
+            else:
+                contrib, ef_commit = named, None
 
         # partners CERTAIN to be joinable (reported exactly our step) get
         # the full straggler window; partners merely NEAR (one behind —
@@ -644,30 +727,53 @@ class CollaborativeOptimizer:
         window = None if partners_certain else near_grace
 
         if self._overlap_allowed(weight_scale):
+            # restore material for a failed overlapped round: with the
+            # device pipeline the RAW accumulator tree stays on device (the
+            # restore is then a device-side add, no host round-trip); the
+            # legacy path keeps the host named copy as before
+            restore = (
+                ("acc", grad_acc, n_acc) if pipeline is not None
+                else ("named", named, n)
+            )
             return self._launch_overlap(
-                state, named, contrib, ef_commit, collab,
+                state, restore, contrib, ef_commit, collab,
                 weight_scale, expected_size, window, partners_certain,
-                n_micro=n,
+                n_micro=n, lossy_d2h=lossy_d2h,
             )
 
         self.performance_ema.pause()
         try:
             wire_start = monotonic_clock()
-            with steps.phase("avg_wire"):
-                averaged, group_size = self._sync_averager_step(
-                    contrib, weight_scale, round_id, expected_size, window,
-                )
+            averaged, group_size = self._sync_averager_step(
+                contrib, weight_scale, round_id, expected_size, window,
+            )
+            if averaged is not None and not isinstance(averaged, dict):
+                # an averager (or test stub) that echoed the FlatFetch
+                # contribution back unresolved: resolve it here
+                averaged = averaged.result()
+            wire_wall = max(0.0, monotonic_clock() - wire_start)
+            # phase attribution stays DISJOINT: the averaging round's wall
+            # splits into the exposed remainder of the D2H stream (the
+            # transfer resolves inside the round, overlapped with
+            # matchmaking — only what matchmaking did NOT cover is a real
+            # stall, ~0 on the loopback harness) and the wire round proper
+            exposed_d2h = (
+                min(fetch.exposed_wait_s, wire_wall)
+                if fetch is not None else 0.0
+            )
+            steps.add("avg_wire", wire_wall - exposed_d2h)
+            if fetch is not None:
+                steps.add("d2h_stream", exposed_d2h)
             if self.overlap_averaging and tele is not None:
                 # overlap ledger, synchronous-fallback form: this round ran
                 # on the trainer's critical path (cooldown after a failed
                 # overlapped round, ramp, gate, desync) — its entire wall is
                 # EXPOSED stall, efficiency 0 (docs/observability.md)
-                exposed = max(0.0, monotonic_clock() - wire_start)
-                tele.counter("opt.overlap_exposed_s").inc(exposed)
+                tele.counter("opt.overlap_exposed_s").inc(wire_wall)
                 tele.gauge("opt.overlap_efficiency").set(0.0)
                 tele.event(
                     "opt.overlap_ledger", round_id=round_id, mode="sync",
-                    hidden_s=0.0, exposed_s=exposed, efficiency=0.0,
+                    hidden_s=0.0, exposed_s=wire_wall, efficiency=0.0,
                 )
             contributors = getattr(
                 self.averager, "last_contributors", group_size
@@ -685,10 +791,21 @@ class CollaborativeOptimizer:
                 # so nobody can be averaging round N without us.)
                 averaged = None
             if averaged is not None:
-                mean_grads = _named_to_tree(averaged, mean_grads)
                 self._round_failures = 0
                 if ef_commit is not None:
-                    self._settle_error_feedback(ef_commit, group_size)
+                    self._settle_error_feedback(
+                        ef_commit, group_size, lossy_d2h
+                    )
+                if not isinstance(averaged, FlatTree):
+                    # a plain named dict (legacy/stubbed averager): rebuild
+                    # the params-shaped tree here so _apply_and_advance can
+                    # tell it apart from a device gradient tree
+                    averaged = _named_to_tree(
+                        averaged, zeros_like_grads(state.params)
+                    )
+                return self._apply_and_advance(
+                    state, averaged, collab, group_size
+                )
             elif partners_certain:
                 self._round_failures += 1
                 if self._round_failures <= self.max_round_retries:
@@ -712,12 +829,18 @@ class CollaborativeOptimizer:
                         f"{round_id}: averaging failed repeatedly — applying "
                         "local grads, will resync"
                     )
-            if averaged is None and weight_scale == 0.0:
+            if weight_scale == 0.0:
                 # no group average received this round (retry budget spent,
                 # or a near-step-only round that came back empty): a
                 # health-gated peer has nothing safe to apply locally
                 return self._drop_gated_grads(state, round_id)
-            return self._apply_and_advance(state, mean_grads, collab, group_size)
+            # local-apply fallback: OUR mean gradients (clip applied, no
+            # residual fold, never quantized) — exactly what the legacy
+            # path applied here; the device tree never left the chip
+            return self._apply_and_advance(
+                state, _fused_mean_clip(grad_acc, n, cap), collab,
+                group_size,
+            )
         finally:
             self.performance_ema.resume()
 
@@ -744,20 +867,113 @@ class CollaborativeOptimizer:
             window=window,
         )
 
-    def _settle_error_feedback(self, ef_commit, group_size: int) -> None:
+    def _settle_error_feedback(
+        self, ef_commit, group_size: int, lossy_d2h: bool = False
+    ) -> None:
         """A round whose result we adopted settles the pending residual.
 
         ``group_size > 1``: the contribution crossed the lossy wire — adopt
-        this round's quantization error as the next residual. A SINGLETON
-        round never touches the wire: the averager hands the contribution
-        tree back verbatim, so grad + residual was applied at FULL
-        precision — the carried residual is consumed, and committing the
-        phantom wire error here would re-inject it next round (the exact
-        bias error feedback exists to remove)."""
-        if group_size > 1:
+        this round's quantization error as the next residual.
+
+        ``lossy_d2h`` (device-flat pipeline under a lossy wire format): the
+        contribution was quantized ON DEVICE, so even a SINGLETON round has
+        crossed the lossy leg — the value we adopted is the dequantized
+        form, and its residual must be committed regardless of group size.
+
+        A legacy singleton round never touches any codec: the averager
+        hands the contribution tree back verbatim, so grad + residual was
+        applied at FULL precision — the carried residual is consumed, and
+        committing the phantom wire error there would re-inject it next
+        round (the exact bias error feedback exists to remove)."""
+        if lossy_d2h or group_size > 1:
             ef_commit()
         else:
             self.error_feedback.reset()
+
+    # ------------------------------------------- device-resident flat seam
+
+    def _ensure_pipeline(self, grad_acc) -> Optional[DeviceFlatPipeline]:
+        """The device-flat pipeline for this gradient schema, or None when
+        disabled / refused (non-float leaves) — the boundary then takes the
+        legacy per-leaf host path."""
+        if not self.device_flat:
+            return None
+        if self._pipeline is not None and self._pipeline.matches_tree(
+            grad_acc
+        ):
+            return self._pipeline
+        try:
+            self._pipeline = DeviceFlatPipeline.for_tree(
+                grad_acc,
+                compression=self.averager.compression.value,
+                telemetry_registry=self.telemetry,
+            )
+        except ValueError as e:
+            logger.warning(
+                f"device-flat pipeline refused this gradient tree ({e}); "
+                "falling back to the host flatten path"
+            )
+            self.device_flat = False
+            self._pipeline = None
+        return self._pipeline
+
+    def _ensure_flat_apply(self, state: TrainState, spec):
+        """The fused flat apply for ``spec``, or None (per-leaf guarded
+        apply) when no factory was wired, a sharded layout is in play, or
+        a previous build failed."""
+        if (
+            self.flat_opt_factory is None
+            or self._flat_apply_failed
+            or self.mesh is not None
+            or self.opt_state_sharding is not None
+            or self.param_sharding is not None
+        ):
+            return None
+        key = [(name, tuple(shape)) for name, shape, _dtype in spec]
+        if self._flat_apply_fn is not None and self._flat_apply_spec == key:
+            return self._flat_apply_fn
+        try:
+            flat_tx = self.flat_opt_factory(spec, state.params)
+            self._flat_apply_fn = make_flat_apply_step(
+                flat_tx, spec, post_apply=self.post_apply
+            )
+            self._flat_apply_spec = key
+        except Exception as e:  # noqa: BLE001 — a flat-apply build failure
+            # must degrade to the per-leaf chain, never kill training
+            logger.warning(
+                f"flat apply unavailable ({e!r}); keeping the per-leaf "
+                "guarded apply"
+            )
+            self._flat_apply_failed = True
+            self._flat_apply_fn = None
+        return self._flat_apply_fn
+
+    def _check_apply_ok(self, final: bool = False) -> None:
+        """Read the PREVIOUS guarded apply's NaN verdict. Called at the
+        next boundary (the flag has long settled — reading it then costs
+        nothing) and once at shutdown (``final=True``); a rolled-back
+        update is logged and counted one boundary late instead of paying a
+        host sync on every global step."""
+        pending, self._pending_apply_ok = self._pending_apply_ok, None
+        if pending is None:
+            return
+        round_id, ok = pending
+        try:
+            rolled_back = not bool(ok)
+        except Exception:  # noqa: BLE001 — a dead device at shutdown must
+            # not mask the real failure
+            return
+        if rolled_back:
+            # NaN guard (CollaborativeCallback.on_step_end semantics,
+            # albert/run_trainer.py:134-137): the update was discarded
+            # inside the jitted apply
+            logger.warning(
+                f"{round_id}: non-finite params; update was rolled back"
+            )
+            tele = telemetry.resolve(self.telemetry)
+            if tele is not None:
+                tele.counter("opt.nan_rollbacks").inc()
+                tele.event("opt.nan_rollback", round_id=round_id)
 
     # ------------------------------------------------- background averaging
 
@@ -779,15 +995,20 @@ class CollaborativeOptimizer:
         )
 
     def _launch_overlap(
-        self, state: TrainState, named, contrib, ef_commit, collab,
+        self, state: TrainState, restore, contrib, ef_commit, collab,
         weight_scale, expected_size, window, partners_certain, n_micro,
+        lossy_d2h=False,
     ):
         """Start the averaging round on the DHT executor and hand control
         straight back to the trainer: the next accumulation phase overlaps
-        matchmaking + the full wire round. The contributed samples are
+        matchmaking + the full wire round — and, with the device pipeline,
+        the gradient D2H stream itself (the transfer resolves inside the
+        round while the trainer accumulates). The contributed samples are
         committed to the in-flight round (accumulators reset); the averaged
         update lands at a later boundary — one boundary of staleness, by
-        contract."""
+        contract. ``restore`` is either ("acc", grad_acc, n_acc) — the raw
+        device accumulators, restored by a device-side add on failure — or
+        the legacy ("named", host_mean_tree, n_micro)."""
         round_id = f"step{collab.optimizer_step}"
         fut = self.averager.step(
             contrib,
@@ -813,12 +1034,13 @@ class CollaborativeOptimizer:
             add_done(_stamp_done)
         self._overlap_inflight = {
             "future": fut,
-            "named": named,  # pre-error-feedback grads, for failure restore
+            "restore": restore,  # pre-error-feedback material for failure
             "commit": ef_commit,
             "collab": collab,
             "samples": self.local_samples_accumulated,
             "n_micro": int(n_micro),
             "partners_certain": partners_certain,
+            "lossy_d2h": lossy_d2h,
         }
         tele = telemetry.resolve(self.telemetry)
         if tele is not None:
@@ -891,15 +1113,22 @@ class CollaborativeOptimizer:
             logger.warning(f"{round_id}: overlapped round raised {e!r}")
             averaged, group_size = None, 1
         contributors = getattr(self.averager, "last_contributors", group_size)
+        if averaged is not None and not isinstance(averaged, dict):
+            # an echoed, unresolved FlatFetch contribution (stubs): resolve
+            averaged = averaged.result()
         if (averaged is not None and contributors <= 1
                 and inflight["partners_certain"]):
             # same replica-divergence guard as the synchronous path: known
             # partners may have averaged without us — do not apply solo
             averaged = None
-        template = zeros_like_grads(state.params)
-        if averaged is not None:
+        if averaged is not None and not isinstance(averaged, FlatTree):
+            # legacy named-dict result: validate against the param schema
+            # before adopting (a FlatTree from our own averager is already
+            # layout-checked)
             try:
-                mean_grads = _named_to_tree(averaged, template)
+                averaged = _named_to_tree(
+                    averaged, zeros_like_grads(state.params)
+                )
             except (KeyError, ValueError) as e:
                 logger.warning(f"{round_id}: overlap result rejected: {e!r}")
                 averaged = None
@@ -910,7 +1139,10 @@ class CollaborativeOptimizer:
             # skips straight to local-apply + resync
             self._round_failures = 0
             if inflight["commit"] is not None:
-                self._settle_error_feedback(inflight["commit"], group_size)
+                self._settle_error_feedback(
+                    inflight["commit"], group_size,
+                    inflight.get("lossy_d2h", False),
+                )
             if tele is not None:
                 tele.counter("opt.overlap_applied").inc()
                 tele.event(
@@ -919,13 +1151,13 @@ class CollaborativeOptimizer:
                     accumulated_during_flight=self.local_samples_accumulated,
                 )
             result = self._apply_and_advance(
-                state, mean_grads, collab, group_size,
+                state, averaged, collab, group_size,
                 keep_acc=(grad_acc, n_acc),
             )
             return (*result, True)
         # failure: fold the committed gradients back into the accumulator
-        # (mean * n_micro reconstructs the sum) and fall back to the
-        # synchronous path — cooldown until a global step succeeds
+        # and fall back to the synchronous path — cooldown until a global
+        # step succeeds
         self._overlap_cooldown = True
         if tele is not None:
             tele.counter("opt.overlap_failed").inc()
@@ -935,12 +1167,23 @@ class CollaborativeOptimizer:
                 f"{round_id}: overlapped round failed — restoring grads, "
                 "falling back to synchronous averaging"
             )
-        restored = _named_to_tree(inflight["named"], template)
-        n_micro = inflight["n_micro"]
-        grad_acc = jax.tree.map(
-            lambda a, m: a + m * n_micro, grad_acc, restored
-        )
-        n_acc = n_acc + n_micro
+        restore = inflight["restore"]
+        if restore[0] == "acc":
+            # device pipeline: the raw accumulators never left the chip —
+            # merge them back with one device-side add, no host round-trip
+            _tag, old_acc, old_n = restore
+            grad_acc = jax.tree.map(lambda a, b: a + b, grad_acc, old_acc)
+            n_acc = n_acc + old_n
+        else:
+            # legacy: mean * n_micro reconstructs the committed sum
+            _tag, named, n_micro = restore
+            restored = _named_to_tree(
+                named, zeros_like_grads(state.params)
+            )
+            grad_acc = jax.tree.map(
+                lambda a, m: a + m * n_micro, grad_acc, restored
+            )
+            n_acc = n_acc + n_micro
         self.local_samples_accumulated += inflight["samples"]
         return state, grad_acc, n_acc, False, False
 
@@ -954,24 +1197,33 @@ class CollaborativeOptimizer:
         round_id = f"step{collab.optimizer_step}"
         t0 = time.perf_counter()
         with steps.phase("opt_apply"):
-            # NaN-rollback backup stays ON DEVICE: an HBM copy of the
-            # pre-apply state costs ~ms, where a host round-trip of the same
-            # bytes costs seconds (and competes with the dispatch stream for
-            # PCIe). The copy is required because apply donates the input
-            # buffers.
-            pre = jax.tree.map(
-                jax.numpy.copy, (state.step, state.params, state.opt_state)
+            # previous boundary's NaN verdict has settled by now — read it
+            # without stalling this boundary's dispatch
+            self._check_apply_ok()
+            # NaN guard now lives INSIDE the jitted apply (a fused
+            # all-finite reduce + jnp.where rollback): no pre-apply HBM
+            # copy of (step, params, opt_state), no host-synced finite
+            # check per global step (make_guarded_apply_step). post_apply
+            # is folded into the same program.
+            flat_fn = (
+                self._ensure_flat_apply(state, mean_grads.spec)
+                if isinstance(mean_grads, FlatTree) else None
             )
-            new_state = self._apply_fn(state, mean_grads)
-            if self.post_apply is not None:
-                new_state = self.post_apply(new_state)
-            if not bool(params_are_finite(new_state.params)):
-                # NaN guard (CollaborativeCallback.on_step_end semantics,
-                # albert/run_trainer.py:134-137): discard this update
-                logger.warning(f"{round_id}: non-finite params; rolling back")
-                new_state = new_state.replace(
-                    step=pre[0], params=pre[1], opt_state=pre[2]
-                )
+            if flat_fn is not None:
+                # fused FLAT apply: the averaged result crosses host->device
+                # as ONE buffer and the whole optimizer update runs as
+                # segment reductions over it (optim/flat.py)
+                flat_dev = jax.device_put(mean_grads.flat)
+                new_state, ok = flat_fn(state, flat_dev)
+            else:
+                if isinstance(mean_grads, FlatTree):
+                    # flat result without a flat apply: rebuild the
+                    # params-shaped tree from the named views (zero-copy)
+                    mean_grads = _named_to_tree(
+                        mean_grads, zeros_like_grads(state.params)
+                    )
+                new_state, ok = self._apply_fn(state, mean_grads)
+            self._pending_apply_ok = (round_id, ok)
         self.seam_ms["apply"] = (time.perf_counter() - t0) * 1e3
         tele = telemetry.resolve(self.telemetry)
         if tele is not None:
@@ -1159,6 +1411,8 @@ class CollaborativeOptimizer:
         # params we are about to replace — feeding it forward would inject
         # stale signal into the first post-resync round
         self.error_feedback.reset()
+        if self._pipeline is not None:
+            self._pipeline.reset_residual()
         new_state = self.load_state_from_peers(state)
         # even if nobody shares state, adopt the global step counter so we
         # rejoin the current round instead of contesting old ones
@@ -1261,5 +1515,6 @@ class CollaborativeOptimizer:
         if inflight is not None:
             inflight["future"].cancel()
             self._overlap_inflight = None
+        self._check_apply_ok(final=True)
         self._join_backup()
         self.averager.shutdown()

@@ -5,10 +5,18 @@ torch_optimizer.Lamb(lr=..., betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
 clamp_value=10000, debias=True) with weight decay excluded for bias and
 LayerNorm parameters. Implemented as composable optax gradient transforms so
 the whole update runs inside the jitted train step (no host round-trip).
+
+``scale_by_lamb`` and the full ``lamb`` chain share ONE implementation of
+the Adam moments / debias / trust-ratio math (the helpers below) — the two
+used to carry inline near-copies, and the flat-segment formulation
+(``optim/flat.py``) adds a third consumer: any drift between them would be
+a silent numerics bug, so the math lives in exactly one place. The helpers
+are written with ``jax.tree.map`` so they work unchanged on parameter
+PYTREES and on the one-leaf flat-buffer form.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Optional
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import chex
 import jax
@@ -20,6 +28,49 @@ class ScaleByLambState(NamedTuple):
     count: chex.Array
     mu: optax.Updates
     nu: optax.Updates
+
+
+def lamb_moments(
+    updates, mu, nu, count, b1: float, b2: float, debias: bool
+) -> Tuple[Any, Any, Any, Any, chex.Array]:
+    """One Adam moment step: returns (mu, nu, mu_hat, nu_hat, count+1).
+
+    ``mu_hat``/``nu_hat`` carry the (optional) bias correction; with
+    ``debias=False`` they alias the raw moments. Structure-agnostic: the
+    arguments may be parameter pytrees or single flat vectors.
+    """
+    mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, mu, updates)
+    nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, nu, updates)
+    count = count + 1
+    if debias:
+        c = count.astype(jnp.float32)
+        mu_hat = jax.tree.map(lambda m: m / (1 - b1 ** c), mu)
+        nu_hat = jax.tree.map(lambda v: v / (1 - b2 ** c), nu)
+    else:
+        mu_hat, nu_hat = mu, nu
+    return mu, nu, mu_hat, nu_hat, count
+
+
+def adam_direction(mu_hat, nu_hat, eps: float):
+    """m / (sqrt(v) + eps), leaf-wise."""
+    return jax.tree.map(lambda m, v: m / (jnp.sqrt(v) + eps), mu_hat, nu_hat)
+
+
+def trust_ratio_scale(
+    w_norm: jnp.ndarray, u_norm: jnp.ndarray, clamp_value: float
+) -> jnp.ndarray:
+    """The LAMB layer-wise trust ratio from precomputed norms:
+    ``min(||w||, clamp_value) / ||u||`` where both norms are positive,
+    else 1.0 (torch_optimizer.Lamb ``clamp_value`` semantics)."""
+    w_norm = jnp.minimum(w_norm, clamp_value)
+    return jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+
+
+def apply_trust_ratio(w, u, clamp_value: float):
+    """Per-leaf trust-ratio scaling of update ``u`` against params ``w``."""
+    w_norm = jnp.linalg.norm(w.astype(jnp.float32))
+    u_norm = jnp.linalg.norm(u.astype(jnp.float32))
+    return u * trust_ratio_scale(w_norm, u_norm, clamp_value)
 
 
 def scale_by_lamb(
@@ -42,28 +93,14 @@ def scale_by_lamb(
 
     def update_fn(updates, state, params):
         assert params is not None, "lamb requires params"
-        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, updates)
-        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, state.nu, updates)
-        count = state.count + 1
-        if debias:
-            mu_hat = jax.tree.map(lambda m: m / (1 - b1 ** count.astype(jnp.float32)), mu)
-            nu_hat = jax.tree.map(lambda v: v / (1 - b2 ** count.astype(jnp.float32)), nu)
-        else:
-            mu_hat, nu_hat = mu, nu
-
-        adam_step = jax.tree.map(
-            lambda m, v: m / (jnp.sqrt(v) + eps), mu_hat, nu_hat
+        mu, nu, mu_hat, nu_hat, count = lamb_moments(
+            updates, state.mu, state.nu, state.count, b1, b2, debias
         )
-
-        def trust_ratio(w, u):
-            w_norm = jnp.minimum(jnp.linalg.norm(w.astype(jnp.float32)), clamp_value)
-            u_norm = jnp.linalg.norm(u.astype(jnp.float32))
-            ratio = jnp.where(
-                (w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0
-            )
-            return u * ratio
-
-        updates = jax.tree.map(trust_ratio, params, adam_step)
+        adam_step = adam_direction(mu_hat, nu_hat, eps)
+        updates = jax.tree.map(
+            lambda w, u: apply_trust_ratio(w, u, clamp_value),
+            params, adam_step,
+        )
         return updates, ScaleByLambState(count=count, mu=mu, nu=nu)
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -103,25 +140,19 @@ def lamb(
     torch_optimizer.Lamb formulation the reference trains with).
     """
     # Decay must enter before the trust-ratio scaling, so we fold it into the
-    # update inside a custom wrapper around scale_by_lamb.
+    # update inside a custom wrapper around the shared scale_by_lamb math.
     inner = scale_by_lamb(b1, b2, eps, clamp_value, debias)
 
     def init_fn(params):
         return inner.init(params)
 
     def update_fn(updates, state, params):
-        # adam moments (without trust ratio) computed by inner on (grads);
-        # we re-implement the ordering here: moments -> +wd*param -> trust.
-        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, updates)
-        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, state.nu, updates)
-        count = state.count + 1
-        c = count.astype(jnp.float32)
-        if debias:
-            mu_hat = jax.tree.map(lambda m: m / (1 - b1 ** c), mu)
-            nu_hat = jax.tree.map(lambda v: v / (1 - b2 ** c), nu)
-        else:
-            mu_hat, nu_hat = mu, nu
-        adam_step = jax.tree.map(lambda m, v: m / (jnp.sqrt(v) + eps), mu_hat, nu_hat)
+        # the same moments -> +wd*param -> trust ordering as scale_by_lamb,
+        # through the SAME helpers — only the weight-decay insertion differs
+        mu, nu, mu_hat, nu_hat, count = lamb_moments(
+            updates, state.mu, state.nu, state.count, b1, b2, debias
+        )
+        adam_step = adam_direction(mu_hat, nu_hat, eps)
 
         if weight_decay > 0.0:
             mask = (
@@ -137,13 +168,10 @@ def lamb(
                 is_leaf=lambda x: x is None,
             )
 
-        def trust_ratio(w, u):
-            w_norm = jnp.minimum(jnp.linalg.norm(w.astype(jnp.float32)), clamp_value)
-            u_norm = jnp.linalg.norm(u.astype(jnp.float32))
-            ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
-            return u * ratio
-
-        updates = jax.tree.map(trust_ratio, params, adam_step)
+        updates = jax.tree.map(
+            lambda w, u: apply_trust_ratio(w, u, clamp_value),
+            params, adam_step,
+        )
         new_state = ScaleByLambState(count=count, mu=mu, nu=nu)
         return updates, new_state
 

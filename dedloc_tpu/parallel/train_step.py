@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import chex
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -151,6 +152,248 @@ def make_apply_step(
         else:
             kwargs.update(in_shardings=(repl, repl), out_shardings=repl)
     return jax.jit(apply, **kwargs)
+
+
+def _all_finite(tree) -> jnp.ndarray:
+    """Fused all-finite reduce over a pytree (or a single flat buffer) —
+    traced INSIDE a jit, unlike the standalone ``params_are_finite`` whose
+    host ``bool()`` readback costs a device sync per call."""
+    finite = jnp.array(True)
+    for leaf in jax.tree.leaves(tree):
+        finite &= jnp.all(jnp.isfinite(leaf))
+    return finite
+
+
+def make_guarded_apply_step(
+    tx: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    opt_state_sharding: Optional[Any] = None,
+    param_sharding: Optional[Any] = None,
+    post_apply: Optional[Callable[["TrainState"], "TrainState"]] = None,
+) -> Callable:
+    """``make_apply_step`` with the NaN guard FUSED into the jit: returns
+    jitted (state, mean_grads) -> (state', ok).
+
+    The collaborative optimizer's rollback used to cost a full
+    ``jax.numpy.copy`` of (step, params, opt_state) before every apply
+    (donation eats the inputs) plus a host-synced ``params_are_finite``
+    readback. Here the all-finite reduce and the ``jnp.where`` rollback run
+    inside the same jitted program: non-finite params select the pre-apply
+    buffers leaf-wise, no extra HBM snapshot, no host round-trip — ``ok``
+    comes back as a device scalar the caller may fetch asynchronously.
+    ``post_apply`` (e.g. SwAV prototype re-normalization) is folded in
+    BEFORE the finite check, preserving the legacy ordering (a post-apply
+    that produces non-finite params also rolls back).
+    """
+
+    def apply(state: TrainState, grads):
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state
+        )
+        if post_apply is not None:
+            new_state = post_apply(new_state)
+        ok = _all_finite(new_state.params)
+        # roll back exactly what the legacy host-side guard restored —
+        # (step, params, opt_state); auxiliary fields (e.g. SwAV batch
+        # stats) keep their post-apply values, as before
+        guarded = new_state.replace(
+            step=jnp.where(ok, new_state.step, state.step),
+            params=jax.tree.map(
+                lambda n, o: jnp.where(ok, n, o),
+                new_state.params, state.params,
+            ),
+            opt_state=jax.tree.map(
+                lambda n, o: jnp.where(ok, n, o),
+                new_state.opt_state, state.opt_state,
+            ),
+        )
+        return guarded, ok
+
+    kwargs = dict(donate_argnums=(0,))
+    if mesh is not None:
+        repl = NamedSharding(mesh, P())
+        p_sh = param_sharding if param_sharding is not None else repl
+        if opt_state_sharding is not None or param_sharding is not None:
+            state_sh = TrainState(
+                step=repl, params=p_sh,
+                opt_state=opt_state_sharding
+                if opt_state_sharding is not None else repl,
+            )
+            kwargs.update(
+                in_shardings=(state_sh, p_sh), out_shardings=(state_sh, repl)
+            )
+        else:
+            kwargs.update(in_shardings=(repl, repl), out_shardings=(repl, repl))
+    return jax.jit(apply, **kwargs)
+
+
+def _replace_opt_states(state, replacements):
+    """Rebuild an optax (possibly chained/nested-tuple) opt_state with the
+    given per-TYPE replacements applied; unknown member states pass through
+    untouched. ``replacements`` maps state type -> replacement callable."""
+    for typ, fn in replacements.items():
+        if isinstance(state, typ):
+            return fn(state)
+    if isinstance(state, tuple) and not hasattr(state, "_fields"):
+        return tuple(_replace_opt_states(s, replacements) for s in state)
+    return state
+
+
+def _find_opt_state(state, typ):
+    if isinstance(state, typ):
+        return state
+    if isinstance(state, tuple) and not hasattr(state, "_fields"):
+        for s in state:
+            found = _find_opt_state(s, typ)
+            if found is not None:
+                return found
+    return None
+
+
+def make_flat_apply_step(
+    flat_tx: Any,
+    spec,
+    post_apply: Optional[Callable[["TrainState"], "TrainState"]] = None,
+    from_tree: bool = False,
+) -> Callable:
+    """Fused FLAT apply: jitted (state, flat_mean_grads) -> (state', ok).
+
+    ``flat_tx`` is an ``optim.flat.FlatLamb`` / ``FlatLars`` adapter and
+    ``spec`` the TreeLayout spec (sorted names) the flat gradient buffer
+    follows — the SAME spec the averaging wire uses, so the averaged result
+    device_puts as ONE buffer and feeds the apply with no per-leaf host
+    work. Inside the one jit: params and moments are flattened onto the
+    layout (pure relayout, fused by XLA), the whole LAMB/LARS update runs
+    as segment reductions over the flat buffer, the all-finite NaN guard
+    reduces over the new flat params in one pass, and the ``jnp.where``
+    rollback selects pre-apply buffers on failure. The persistent
+    ``opt_state`` stays the optax TREE state (checkpoints / peer state
+    sync / schema fingerprints unchanged); moments only take their flat
+    form transiently inside the jit. Donation end-to-end: the state's
+    buffers alias their successors (see the donate note at the bottom).
+
+    ``from_tree=True`` builds the same program taking a params-shaped
+    gradient TREE instead of the flat buffer (the solo fast path, where
+    gradients never left the device and were never flattened).
+
+    Single-mesh only: sharded layouts keep the per-leaf chain
+    (``make_guarded_apply_step``) — GSPMD wants the tree structure.
+    """
+    from dedloc_tpu.optim.flat import FlatLamb, FlatLars
+    from dedloc_tpu.optim.lamb import ScaleByLambState
+    from dedloc_tpu.optim.lars import LarsState
+
+    names = [name for name, _shape, _dtype in spec]
+    shapes = [shape for _name, shape, _dtype in spec]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+
+    def _tree_order(template):
+        """Permutation: position in spec (sorted names) per tree leaf."""
+        flat = jax.tree_util.tree_flatten_with_path(template)[0]
+        leaf_names = [
+            jax.tree_util.keystr(path) or f"leaf{i}"
+            for i, (path, _leaf) in enumerate(flat)
+        ]
+        index = {n: i for i, n in enumerate(names)}
+        if sorted(leaf_names) != sorted(names):
+            raise ValueError(
+                "flat apply spec does not match the parameter tree"
+            )
+        return [index[n] for n in leaf_names], leaf_names
+
+    def _flatten(tree, order):
+        leaves = jax.tree.leaves(tree)
+        by_spec = [None] * len(leaves)
+        for leaf, pos in zip(leaves, order):
+            by_spec[pos] = leaf.astype(jnp.float32).reshape(-1)
+        return jnp.concatenate(by_spec) if by_spec else jnp.zeros(
+            (0,), jnp.float32
+        )
+
+    def _unflatten_like(flat, template, order):
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        offsets = np.cumsum([0] + sizes)
+        out = []
+        for leaf, pos in zip(leaves, order):
+            chunk = flat[offsets[pos]:offsets[pos] + sizes[pos]]
+            out.append(chunk.reshape(leaf.shape).astype(leaf.dtype))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def apply(state: TrainState, grads):
+        order, _ = _tree_order(state.params)
+        flat_grads = _flatten(grads, order) if from_tree else grads
+        flat_params = _flatten(state.params, order)
+        sched = _find_opt_state(state.opt_state, optax.ScaleByScheduleState)
+        sched_count = (
+            sched.count if sched is not None else jnp.zeros([], jnp.int32)
+        )
+        if isinstance(flat_tx, FlatLamb):
+            inner = _find_opt_state(state.opt_state, ScaleByLambState)
+            assert inner is not None, "flat LAMB needs a lamb() opt_state"
+            updates, new_mu, new_nu, new_count = flat_tx.update(
+                flat_grads, flat_params,
+                _flatten(inner.mu, order), _flatten(inner.nu, order),
+                inner.count, sched_count,
+            )
+            replacements = {
+                ScaleByLambState: lambda s: ScaleByLambState(
+                    count=new_count,
+                    mu=_unflatten_like(new_mu, s.mu, order),
+                    nu=_unflatten_like(new_nu, s.nu, order),
+                ),
+                optax.ScaleByScheduleState: lambda s: (
+                    optax.ScaleByScheduleState(count=s.count + 1)
+                ),
+            }
+        elif isinstance(flat_tx, FlatLars):
+            inner = _find_opt_state(state.opt_state, LarsState)
+            assert inner is not None, "flat LARS needs a lars() opt_state"
+            updates, new_mom = flat_tx.update(
+                flat_grads, flat_params,
+                _flatten(inner.momentum, order), sched_count,
+            )
+            replacements = {
+                LarsState: lambda s: LarsState(
+                    momentum=_unflatten_like(new_mom, s.momentum, order)
+                ),
+                optax.ScaleByScheduleState: lambda s: (
+                    optax.ScaleByScheduleState(count=s.count + 1)
+                ),
+            }
+        else:  # pragma: no cover - guarded by the caller
+            raise TypeError(f"unsupported flat optimizer {type(flat_tx)!r}")
+        new_flat_params = flat_params + updates
+        new_state = state.replace(
+            step=state.step + 1,
+            params=_unflatten_like(new_flat_params, state.params, order),
+            opt_state=_replace_opt_states(state.opt_state, replacements),
+        )
+        if post_apply is not None:
+            new_state = post_apply(new_state)
+            ok = _all_finite(new_state.params)
+        else:
+            # one fused reduce over the flat buffer
+            ok = jnp.all(jnp.isfinite(new_flat_params))
+        guarded = new_state.replace(
+            step=jnp.where(ok, new_state.step, state.step),
+            params=jax.tree.map(
+                lambda n, o: jnp.where(ok, n, o),
+                new_state.params, state.params,
+            ),
+            opt_state=jax.tree.map(
+                lambda n, o: jnp.where(ok, n, o),
+                new_state.opt_state, state.opt_state,
+            ),
+        )
+        return guarded, ok
+
+    # donation end-to-end applies to the STATE (params/moments alias their
+    # successors in-place). The incoming gradient buffer/tree is consumed
+    # by the relayout but has no same-shaped output to alias — declaring
+    # it donated would only emit the unusable-donation warning.
+    return jax.jit(apply, donate_argnums=(0,))
 
 
 def make_local_train_step(

@@ -93,6 +93,36 @@ def build_optimizer(args: CollaborationArguments):
     )
 
 
+def build_flat_opt_factory(args: CollaborationArguments):
+    """(spec, params) -> optim.flat.FlatLamb for the SAME hyperparameters
+    as ``build_optimizer`` — the fused flat apply's math twin of the
+    per-leaf chain (--optimizer.flat_apply; equivalence locked by
+    tests/test_optim.py). Returns a factory because the TreeLayout spec
+    only exists once the first gradient tree does."""
+    schedule = linear_warmup_linear_decay(
+        args.training.learning_rate,
+        warmup_steps=args.training.warmup_steps,
+        total_steps=args.training.total_steps,
+    )
+
+    def factory(spec, params):
+        from dedloc_tpu.optim.flat import FlatLamb, tree_flags
+        from dedloc_tpu.optim.lamb import albert_weight_decay_mask
+
+        flags = tree_flags(
+            albert_weight_decay_mask(params), params,
+            [name for name, _shape, _dtype in spec],
+        )
+        return FlatLamb(
+            spec, flags, schedule,
+            weight_decay=args.training.weight_decay,
+            clamp_value=args.training.clamp_value,
+            max_grad_norm=args.training.max_grad_norm,
+        )
+
+    return factory
+
+
 def single_device_attention_impl(impl: str) -> str:
     """Attention impl for shape-only / single-device roles (aux template
     fallback, evaluate): 'ring' needs the trainer's sequence-parallel mesh

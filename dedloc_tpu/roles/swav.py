@@ -79,6 +79,27 @@ def build_swav(args: SwAVCollaborationArguments):
     return cfg, spec, model, tx
 
 
+def _build_flat_lars_factory(t):
+    """(spec, params) -> optim.flat.FlatLars mirroring ``build_swav``'s
+    LARS hyperparameters (fused flat apply; --optimizer.flat_apply)."""
+    schedule = linear_warmup_cosine_annealing(
+        t.learning_rate, t.warmup_steps, t.total_steps
+    )
+
+    def factory(spec, params):
+        from dedloc_tpu.optim.flat import FlatLars
+
+        # build_swav's lars() passes no exclude_mask_fn: no skipped spans
+        return FlatLars(
+            spec, [False] * len(spec), schedule,
+            momentum=t.momentum,
+            weight_decay=t.weight_decay,
+            trust_coefficient=t.trust_coefficient,
+        )
+
+    return factory
+
+
 def run_swav(args: SwAVCollaborationArguments) -> TrainState:
     force_cpu_if_requested()
     t = args.training
@@ -155,6 +176,13 @@ def run_swav(args: SwAVCollaborationArguments) -> TrainState:
         health_gate_loss_ratio=args.optimizer.health_gate_loss_ratio,
         state_sync_retries=args.averager.state_sync_retries,
         state_sync_backoff=args.averager.state_sync_backoff,
+        # device-resident gradient pipeline + fused flat LARS apply (same
+        # knobs as the ALBERT trainer; docs/perf.md round 6)
+        device_flat=args.optimizer.device_flat,
+        flat_opt_factory=(
+            _build_flat_lars_factory(t)
+            if args.optimizer.flat_apply else None
+        ),
         # swarm checkpointing (--checkpoint.*): same wiring as the ALBERT
         # trainer — sharded serving/catalog/restore with blob fallback
         **checkpoint_kwargs(args, _public_key),

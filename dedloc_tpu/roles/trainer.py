@@ -38,6 +38,7 @@ from dedloc_tpu.parallel.train_step import (
 )
 from dedloc_tpu.roles.common import (
     build_dht,
+    build_flat_opt_factory,
     build_loss_fn,
     build_model,
     build_optimizer,
@@ -258,6 +259,14 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         health_gate_loss_ratio=args.optimizer.health_gate_loss_ratio,
         state_sync_retries=args.averager.state_sync_retries,
         state_sync_backoff=args.averager.state_sync_backoff,
+        # device-resident gradient pipeline + fused flat apply
+        # (--optimizer.device_flat / --optimizer.flat_apply; docs/perf.md
+        # round 6): compressed D2H streaming and one-buffer apply
+        device_flat=args.optimizer.device_flat,
+        flat_opt_factory=(
+            build_flat_opt_factory(args)
+            if args.optimizer.flat_apply else None
+        ),
         # swarm checkpointing (--checkpoint.*): sharded state serving +
         # catalog announcements + multi-peer restore, blob as fallback
         **checkpoint_kwargs(args, public_key),

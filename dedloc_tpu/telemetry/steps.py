@@ -16,9 +16,16 @@ Canonical phases (docs/observability.md "Step-phase flight recorder"):
 - ``fwd_bwd``      jitted accumulate dispatch + the boundary's
                    ``block_until_ready`` (XLA runs async — without the
                    block a timer measures dispatch, not execution)
-- ``grad_flatten`` device_get + tree flatten of the mean grads (the
-                   jit↔host seam crossing)
-- ``avg_wire``     the synchronous averaging round (matchmaking + wire)
+- ``grad_flatten`` launching the device-side flatten/quantize program (or,
+                   on the legacy path, the per-leaf device_get + host
+                   flatten of the mean grads — the jit↔host seam crossing)
+- ``d2h_stream``   the EXPOSED remainder of the async device→host gradient
+                   stream: the transfer overlaps matchmaking (and, in
+                   overlap mode, accumulation), so this phase reads ~0
+                   when the overlap works and grows when the link is the
+                   bottleneck (averaging/device_flat.py)
+- ``avg_wire``     the synchronous averaging round (matchmaking + wire),
+                   net of the exposed D2H wait above
 - ``opt_apply``    optimizer apply + NaN guard
 - ``collab``       progress-tracker reads/reports (DHT overhead)
 
@@ -58,8 +65,8 @@ from dedloc_tpu.telemetry import registry
 # these (tools/runlog_summary.py keeps a deliberate copy, _CANONICAL_PHASES,
 # because the tool is stdlib-only; keep the two in sync)
 PHASES = (
-    "data_wait", "h2d", "fwd_bwd", "grad_flatten", "avg_wire", "opt_apply",
-    "collab",
+    "data_wait", "h2d", "fwd_bwd", "grad_flatten", "d2h_stream", "avg_wire",
+    "opt_apply", "collab",
 )
 
 # bf16 peak TFLOP/s per chip by PJRT device_kind substring — the same table

@@ -96,6 +96,40 @@ def test_bench_allreduce_pipeline_contract():
     assert wire["none"] / wire["uint8"] >= 3.5, wire
 
 
+def _run_grad_pipeline_bench(compression="float16"):
+    env = dict(os.environ, DEDLOC_BENCH="grad_pipeline",
+               DEDLOC_BENCH_TINY="1", JAX_PLATFORMS="cpu",
+               DEDLOC_BENCH_TIMING="0",
+               DEDLOC_BENCH_COMPRESSION=compression)
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py")],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    json_lines = [
+        l for l in out.stdout.strip().splitlines() if l.startswith("{")
+    ]
+    assert len(json_lines) == 1, out.stdout
+    return json.loads(json_lines[0])
+
+
+def test_bench_grad_pipeline_contract():
+    """Boundary-seam bench (PR 13), deterministic byte-accounting half
+    (DEDLOC_BENCH_TIMING=0): the device-flat pipeline's D2H bytes are
+    exactly half the legacy fp32 seam under float16 and ~quarter under
+    uint8 (per-block lo/scale meta keeps the ratio a hair under 4.0);
+    fp32 ('none') moves the same bytes, just fewer transfers."""
+    f16 = _run_grad_pipeline_bench("float16")
+    assert f16["metric"] == "grad_pipeline_d2h_bytes_per_boundary"
+    assert f16["legacy_d2h_bytes"] == f16["n_params"] * 4
+    assert f16["vs_baseline"] == 2.0
+    u8 = _run_grad_pipeline_bench("uint8")
+    assert u8["vs_baseline"] >= 3.5
+    raw = _run_grad_pipeline_bench("none")
+    assert raw["vs_baseline"] == 1.0
+
+
 def _run_restore_bench(timing=True):
     env = dict(os.environ, DEDLOC_BENCH="checkpoint_restore",
                DEDLOC_BENCH_TINY="1", JAX_PLATFORMS="cpu",

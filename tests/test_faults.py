@@ -276,6 +276,8 @@ def test_ramped_join_scales_contribution_weight():
             def capture_step(named, weight, round_id, **kw):
                 weights.append(weight)
                 opt.averager.last_contributors = 2
+                if hasattr(named, "result") and not isinstance(named, dict):
+                    named = named.result()  # device-flat FlatFetch
                 return dict(named), 2
 
             opt.averager.step = capture_step
@@ -327,6 +329,8 @@ def test_health_gate_defers_mixing_until_loss_rejoins_pack():
             def capture_step(named, weight, round_id, **kw):
                 weights.append(weight)
                 opt.averager.last_contributors = 2
+                if hasattr(named, "result") and not isinstance(named, dict):
+                    named = named.result()  # device-flat FlatFetch
                 return dict(named), 2
 
             opt.averager.step = capture_step

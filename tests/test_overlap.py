@@ -54,6 +54,10 @@ class _StubAverager:
 
     def __call__(self, tree, weight, round_id, return_future=False,
                  expected_size=None, window=None):
+        if hasattr(tree, "result") and not isinstance(tree, dict):
+            # device-flat contribution (FlatFetch): resolve it the way the
+            # real averager does — the stub then sees the decoded FlatTree
+            tree = tree.result()
         self.calls.append({
             "tree": tree, "weight": weight, "round_id": round_id,
             "return_future": return_future,
@@ -287,16 +291,59 @@ def test_overlap_gated_off_during_ramp_health_gate_and_resync(overlap_opt):
     assert stub.calls[-1]["weight"] == pytest.approx(16.0 * ramped)
 
 
-def test_singleton_round_consumes_residual_instead_of_committing(
-    overlap_opt,
-):
-    """Error-feedback settle discipline: a group-of-one round hands the
-    contribution back VERBATIM (no wire, no loss) — grad + residual was
-    applied at full precision, so the residual must reset; committing the
-    phantom wire error would re-inject it every singleton round. A real
-    multi-member round commits it (the wire really dropped it)."""
+def test_singleton_round_commits_device_quantization_residual(overlap_opt):
+    """Error-feedback settle discipline on the DEVICE pipeline: the
+    contribution is quantized before it ever leaves the chip, so even a
+    group-of-one round has crossed the lossy leg — the residual must be
+    COMMITTED (the adopted value really is the dequantized form), unlike
+    the legacy host path where a singleton echo was full-precision."""
     opt, stub, holder = overlap_opt
     opt.overlap_averaging = False  # exercise the synchronous path
+    assert opt.error_feedback.enabled  # float16 default
+
+    # a REAL (group of 2) round commits this round's device residual
+    holder["state"] = _collab()
+    state, params, ones = _fresh(opt)
+    stub.sync_results.append(
+        ({"['w']": np.full((2, 1), 0.25, np.float32)}, 2)
+    )
+    state, grad_acc, n_acc, stepped = opt.step(
+        state, {"w": jnp.full((2, 1), 1.0 / 3.0)},
+        jnp.asarray(1, jnp.int32), samples=16,
+    )
+    assert stepped
+    assert opt._pipeline is not None, "device pipeline must be active"
+    seeded = opt._pipeline.residual_norm()
+    assert seeded > 0, "a lossy D2H round must leave a residual"
+    # the host-side error feedback never engaged: the device owns the seam
+    assert opt.error_feedback.residual_norm() == 0.0
+
+    # a SINGLETON round (partners merely near-step, so the contributors
+    # guard lets the verbatim result through) STILL commits: the echoed
+    # contribution is the dequantized device representation
+    holder["state"] = _collab(step=1, at_step=1)
+    stub.sync_results.append("ECHO_SINGLETON")
+    state, grad_acc, n_acc, stepped = opt.step(
+        state, {"w": jnp.full((2, 1), 1.0 / 3.0)},
+        jnp.asarray(1, jnp.int32), samples=16,
+    )
+    assert stepped
+    assert opt._pipeline.residual_norm() > 0, (
+        "a device-quantized singleton adopts the lossy form and must "
+        "commit its residual"
+    )
+
+
+def test_singleton_round_consumes_residual_on_legacy_host_path(overlap_opt):
+    """Legacy host-path settle discipline (device pipeline off): a
+    group-of-one round hands the contribution back VERBATIM (no wire, no
+    loss) — grad + residual was applied at full precision, so the residual
+    must reset; committing the phantom wire error would re-inject it every
+    singleton round. A real multi-member round commits it (the wire really
+    dropped it)."""
+    opt, stub, holder = overlap_opt
+    opt.overlap_averaging = False  # exercise the synchronous path
+    opt.device_flat = False  # legacy per-leaf host seam
     assert opt.error_feedback.enabled  # float16 default
 
     # seed a residual via a REAL (group of 2) round

@@ -332,6 +332,8 @@ class _StubAverager:
 
     def __call__(self, tree, weight, round_id, return_future=False,
                  expected_size=None, window=None):
+        if hasattr(tree, "result") and not isinstance(tree, dict):
+            tree = tree.result()  # device-flat FlatFetch -> FlatTree
         self.calls.append({"tree": tree, "return_future": return_future})
         if return_future:
             assert self.pending is None
@@ -450,6 +452,8 @@ def test_overlap_ledger_drops_to_zero_on_sync_fallback(
             assert not return_future
             clock.advance(2.0)
             opt.averager.last_contributors = 2
+            if hasattr(tree, "result") and not isinstance(tree, dict):
+                tree = tree.result()  # device-flat FlatFetch
             return {k: np.full_like(v, 0.25) for k, v in tree.items()}, 2
 
         opt.averager.step = slow_sync

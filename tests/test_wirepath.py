@@ -309,6 +309,57 @@ def test_error_feedback_uint8_unbiased_over_rounds(rng):
     assert late < 4 * early + 1e-6, f"residual norm grew: {residual_norms}"
 
 
+def test_device_ef_uint8_drift_free_through_wire_requantize(rng):
+    """PR 13 acceptance: the DEVICE-quantized contribution
+    (averaging/device_flat.py) stays drift-free over 25 simulated rounds
+    even though the network wire RE-quantizes the decoded form per chunk
+    with its own affine grid. The device residual only models the D2H
+    leg; the wire's re-quantization of an already-on-grid signal is
+    second-order and must stay bounded (the approximation
+    collaborative/error_feedback.py documents), while the naive
+    no-feedback wire drifts visibly on the same signal."""
+    import jax.numpy as jnp
+
+    from dedloc_tpu.averaging.device_flat import DeviceFlatPipeline
+
+    rounds = 25
+    base = rng.standard_normal(257).astype(np.float32)
+    pipe = DeviceFlatPipeline.for_tree(
+        {"g": jnp.asarray(base)}, compression="uint8", chunk_elems=100
+    )
+
+    def wire(flat):
+        # the network leg: per-chunk uint8 re-encode of the contribution
+        out = np.empty_like(flat)
+        for lo in range(0, flat.size, 100):
+            out[lo:lo + 100] = wire_roundtrip(
+                flat[lo:lo + 100], CompressionType.UINT8
+            )
+        return out
+
+    sum_true = np.zeros_like(base)
+    sum_ef = np.zeros_like(base)
+    sum_naive = np.zeros_like(base)
+    for t in range(rounds):
+        grad = base + 0.01 * rng.standard_normal(base.shape).astype(
+            np.float32
+        )
+        sum_true += grad
+        fetch = pipe.fetch({"g": jnp.asarray(grad)}, use_ef=True)
+        sum_ef += wire(fetch.result().flat)
+        pipe.commit(fetch)
+        sum_naive += wire(
+            wire_roundtrip(grad, CompressionType.UINT8)
+        )
+    ef_err = float(np.max(np.abs(sum_ef - sum_true)))
+    naive_err = float(np.max(np.abs(sum_naive - sum_true)))
+    assert ef_err < 0.15, f"device EF drifted through the wire: {ef_err}"
+    assert naive_err > 3 * ef_err, (
+        f"naive double-quantized wire should drift: naive={naive_err} "
+        f"ef={ef_err}"
+    )
+
+
 def test_error_feedback_none_is_identity(rng):
     ef = ErrorFeedback("none")
     assert not ef.enabled

@@ -877,8 +877,8 @@ def print_topology(all_rows):
 # coordinator's swarm_health.peers[].phases carries the folded means.)
 
 _CANONICAL_PHASES = (
-    "data_wait", "h2d", "fwd_bwd", "grad_flatten", "avg_wire", "opt_apply",
-    "collab",
+    "data_wait", "h2d", "fwd_bwd", "grad_flatten", "d2h_stream", "avg_wire",
+    "opt_apply", "collab",
 )
 
 
