@@ -50,7 +50,6 @@ failure costs one round' semantics, contributor notebook cell 3).
 from __future__ import annotations
 
 import asyncio
-import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -144,7 +143,7 @@ class _RoundState:
         in place; local slices (possibly views of the caller's reused flat
         buffer) are copied first."""
         st = self.chunk(c)
-        t0 = time.perf_counter()
+        t0 = telemetry.monotonic_clock()
         if st.acc is None:
             if own and part.dtype == np.float32 and part.flags["C_CONTIGUOUS"]:
                 st.acc = part
@@ -153,7 +152,7 @@ class _RoundState:
             native.scale(st.acc, weight)
         else:
             native.axpy(st.acc, part, weight)
-        self.reduce_s += time.perf_counter() - t0
+        self.reduce_s += telemetry.monotonic_clock() - t0
         st.weight += weight
 
     def maybe_finalize(self, c: int) -> None:
@@ -174,9 +173,9 @@ class _RoundState:
         if st.done.done():
             return
         if st.weight > 0:
-            t0 = time.perf_counter()
+            t0 = telemetry.monotonic_clock()
             reduced = native.scale(st.acc, 1.0 / st.weight)
-            self.reduce_s += time.perf_counter() - t0
+            self.reduce_s += telemetry.monotonic_clock() - t0
         else:
             # all-aux group: nothing to average; serve my own slice (copied —
             # local_span may view a flat buffer the caller reuses next round,
@@ -430,10 +429,10 @@ class GroupAllReduce:
         # parks at its host and completes the moment that chunk reduces, so
         # reduced chunks flow back while later chunks are still being
         # scattered/reduced — this is where the pipeline wins its wall-clock
-        gather_start = time.perf_counter()
+        gather_start = telemetry.monotonic_clock()
 
         async def fetch_chunk(j: int, c: int, clo: int, chi: int) -> None:
-            t0 = time.perf_counter()
+            t0 = telemetry.monotonic_clock()
             reply = await self.client.call(
                 endpoints[j],
                 "avg.get_reduced",
@@ -448,7 +447,7 @@ class GroupAllReduce:
             np.copyto(out[clo:chi], data.reshape(-1), casting="unsafe")
             if tele is not None:
                 raw = (chi - clo) * 4
-                dt = time.perf_counter() - t0
+                dt = telemetry.monotonic_clock() - t0
                 wire = len(reply["data"])
                 tele.counter("allreduce.bytes_received").inc(raw)
                 tele.counter("allreduce.chunks_received").inc()
@@ -574,7 +573,7 @@ class GroupAllReduce:
                     tele.counter("avg.bytes_saved").inc(
                         max(0, raw - len(payload))
                     )
-                t0 = time.perf_counter()
+                t0 = telemetry.monotonic_clock()
                 await self.client.call(
                     endpoints[j], "avg.part",
                     {
@@ -584,7 +583,7 @@ class GroupAllReduce:
                     timeout=self.timeout,
                 )
                 if tele is not None:
-                    dt = time.perf_counter() - t0
+                    dt = telemetry.monotonic_clock() - t0
                     tele.links().observe_transfer(
                         endpoints[j], len(payload), dt
                     )
@@ -637,7 +636,7 @@ class GroupAllReduce:
             raise
         if ctx is not None and isinstance(ctx, dict):
             ctx["gather_wait_s"] = round(
-                time.perf_counter() - gather_start, 6
+                telemetry.monotonic_clock() - gather_start, 6
             )
             ctx["chunks"] = sum(len(c) for c in chunks_by_host)
         if tele is not None:

@@ -57,7 +57,9 @@ def _sweep_orphan_tmpfiles(
 ) -> None:
     if not os.path.isdir(directory):
         return
-    now = time.time()
+    # dedlint: disable=clock-wall — compared against st_mtime (wall by
+    # definition); virtual time would mis-age real files
+    now = time.time()  # dedlint: disable=clock-wall
     for name in os.listdir(directory):
         if not name.endswith(".tmp"):
             continue

@@ -1397,7 +1397,12 @@ class CollaborativeOptimizer:
         except (KeyError, ValueError) as e:
             logger.warning(f"peer state incompatible ({e!r}); keeping local")
             return state
-        self.local_step = remote_step
+        # dedlint: disable=lock-unguarded-mutation — entered either from
+        # step() -> _catch_up() with self._lock held, or from the role's
+        # join/bootstrap path before the training loop (and its threads)
+        # exists; taking the non-reentrant lock here would deadlock the
+        # _catch_up path
+        self.local_step = remote_step  # dedlint: disable=lock-unguarded-mutation
         new_state = state.replace(
             step=jax.numpy.asarray(int(metadata.get("step", 0)), jax.numpy.int32),
             params=self._device_put(params, self.param_sharding),
@@ -1501,7 +1506,10 @@ class CollaborativeOptimizer:
             # failed round must leave local_step put so the aux retries the
             # SAME round (and its presence record doesn't claim progress
             # it never made)
-            self.local_step = collab.optimizer_step + 1
+            # dedlint: disable=lock-unguarded-mutation — auxiliary peers
+            # never run step(): local_step is only ever touched by the one
+            # aux loop thread, there is no trainer thread to race
+            self.local_step = collab.optimizer_step + 1  # dedlint: disable=lock-unguarded-mutation
             self._aux_misses = 0
         else:
             self._aux_misses += 1

@@ -48,6 +48,30 @@ def set_dht_time_source(source) -> None:
     _dht_time_source = source
 
 
+def monotonic() -> float:
+    """Monotonic duration/deadline clock for simulator-reachable code.
+
+    Production (no fake source, offset 0) is plain ``time.monotonic()``.
+    Under ``FakeClock`` the offset advances it exactly with scenario time;
+    under the discrete-event simulator the installed source replaces it
+    entirely, so deadlines computed from it expire on the VIRTUAL timeline
+    instead of counting real host-execution seconds. This is the approved
+    clock the dedlint ``clock-monotonic`` rule points at — raw
+    ``time.monotonic()``/``time.perf_counter()`` in sim-reachable modules
+    is blind to both mechanisms (docs/contributor.md).
+
+    Like ``get_dht_time()``, the value is DISCONTINUOUS across a frozen-
+    source install/uninstall (`set_dht_time_source`): a timestamp taken on
+    one side compared on the other yields nonsense ages. The standing
+    contract (same one every FakeClock/simulator consumer already lives
+    by) is that objects are created and driven on the SAME side — the sim
+    engine spawns its peers inside the engine context, and FakeClock test
+    scenarios construct their components inside the clock's scope."""
+    if _dht_time_source is not None:
+        return _dht_time_source()
+    return time.monotonic() + _dht_time_offset
+
+
 T = TypeVar("T")
 
 

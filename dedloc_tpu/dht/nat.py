@@ -39,8 +39,10 @@ from __future__ import annotations
 
 import asyncio
 import socket
-import time
 from typing import Dict, Optional, Tuple
+
+from dedloc_tpu.core import timeutils
+from dedloc_tpu.utils.aio import keep_task
 
 from dedloc_tpu.dht.protocol import (
     Endpoint,
@@ -137,7 +139,7 @@ class NatTraversal:
         vep = relay_endpoint(relay, bytes.fromhex(peer_hex))
         if vep in self.client._conns:
             return "conn"
-        now = time.monotonic()
+        now = timeutils.monotonic()
         if now - self._failed.get(peer_hex, -1e9) < self.failure_ttl:
             return None
         lock = self._locks.setdefault(peer_hex, asyncio.Lock())
@@ -152,13 +154,13 @@ class NatTraversal:
                 return await self._punch_initiate(relay, peer_hex)
             except Exception as e:  # noqa: BLE001 — any failure => relay
                 logger.debug(f"nat upgrade to {peer_hex[:12]} failed: {e!r}")
-                self._failed[peer_hex] = time.monotonic()
+                self._failed[peer_hex] = timeutils.monotonic()
                 return None
 
     # ------------------------------------------------------------- reversal
 
     async def _reverse(self, relay: Endpoint, peer_hex: str) -> Optional[str]:
-        self._expected[peer_hex] = time.monotonic()
+        self._expected[peer_hex] = timeutils.monotonic()
         await self.client.call(
             relay,
             "relay.call",
@@ -184,7 +186,8 @@ class NatTraversal:
         peer_hex = args["peer_id"]
         solicited_at = self._expected.get(peer_hex)
         if (solicited_at is None
-                or time.monotonic() - solicited_at > 2 * self.handshake_timeout):
+                or timeutils.monotonic() - solicited_at
+                > 2 * self.handshake_timeout):
             raise PermissionError(
                 f"unsolicited nat registration for {peer_hex[:12]!r}"
             )
@@ -307,8 +310,10 @@ class NatTraversal:
         # initiator's self-reported private bind host
         remote = (args.get("observed_host") or args["host"], int(args["port"]))
         # reply first (the initiator needs our port), punch in background
-        asyncio.ensure_future(
-            self._punch_run(lsock, remote, their_hex, vep)
+        # (retained + exception-logged: a failed punch must be visible)
+        keep_task(
+            self._punch_run(lsock, remote, their_hex, vep),
+            name="nat punch", log=logger,
         )
         return {"host": self.bind_host, "port": port}
 
@@ -323,18 +328,18 @@ class NatTraversal:
         with nat.hello, adopt into the client pool under ``vep``."""
         loop = asyncio.get_event_loop()
         local = lsock.getsockname()
-        deadline = time.monotonic() + self.handshake_timeout
+        deadline = timeutils.monotonic() + self.handshake_timeout
         accepted: Optional[socket.socket] = None
         connected: Optional[socket.socket] = None
 
         async def _accept():
             nonlocal accepted
             lsock.listen(1)
-            while time.monotonic() < deadline and accepted is None:
+            while timeutils.monotonic() < deadline and accepted is None:
                 try:
                     conn, _ = await asyncio.wait_for(
                         loop.sock_accept(lsock),
-                        timeout=max(0.05, deadline - time.monotonic()),
+                        timeout=max(0.05, deadline - timeutils.monotonic()),
                     )
                     conn.setblocking(False)
                     accepted = conn
@@ -346,7 +351,7 @@ class NatTraversal:
 
         async def _dial():
             nonlocal connected
-            while time.monotonic() < deadline and connected is None:
+            while timeutils.monotonic() < deadline and connected is None:
                 s = _punch_socket(local[0], local[1])
                 try:
                     await asyncio.wait_for(
@@ -368,7 +373,8 @@ class NatTraversal:
                  asyncio.ensure_future(_dial())]
         # wait until SOME path established, then a short grace for the other
         # so both sides can apply the same tie-break
-        while time.monotonic() < deadline and accepted is None and connected is None:
+        while (timeutils.monotonic() < deadline and accepted is None
+               and connected is None):
             await asyncio.sleep(0.03)
         await asyncio.sleep(0.25)
         for t in tasks:

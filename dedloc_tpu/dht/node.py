@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from dedloc_tpu.core import timeutils
 from dedloc_tpu.core.timeutils import DHTExpiration, ValueWithExpiration, get_dht_time
 from dedloc_tpu.dht.protocol import Endpoint, RPCClient, RPCServer
 from dedloc_tpu.dht.routing import DHTID, NodeInfo, RoutingTable
@@ -410,10 +411,8 @@ class DHTNode:
         Returns counters (tests and soak harnesses call this directly with
         a fake clock instead of waiting out ``maintenance_interval``).
         """
-        import time as _time
-
         stats = {"evicted": 0, "refreshed_buckets": 0, "republished": 0}
-        now = _time.monotonic()
+        now = timeutils.monotonic()
         # 1. stale-peer eviction, pings in parallel (a mass disconnect must
         # not serialize N x request_timeout inside one pass); ping success
         # re-registers with a fresh last_seen via _ping's add_or_update
@@ -433,21 +432,21 @@ class DHTNode:
                     stats["evicted"] += 1
         # 2. bucket refresh
         for bucket in list(self.routing_table.buckets):
-            if (_time.monotonic() - bucket.last_refreshed
+            if (timeutils.monotonic() - bucket.last_refreshed
                     < self.bucket_refresh_interval):
                 continue
             target = self.routing_table.random_id_in(bucket)
             await self.find_nearest_nodes(target)
-            bucket.last_refreshed = _time.monotonic()
+            bucket.last_refreshed = timeutils.monotonic()
             stats["refreshed_buckets"] += 1
         # 3. record re-replication — on its own (much longer) cadence
         due = (
             self._last_replication is None
-            or _time.monotonic() - self._last_replication
+            or timeutils.monotonic() - self._last_replication
             >= self.replication_interval
         )
         if not self.client_mode and due:
-            self._last_replication = _time.monotonic()
+            self._last_replication = timeutils.monotonic()
             dht_now = get_dht_time()
             for key in self.storage.keys():
                 entry = self.storage.get(key)  # prunes expired subkeys

@@ -30,6 +30,7 @@ from dedloc_tpu.core.serialization import pack_obj, unpack_obj
 from dedloc_tpu.dht import transport as transport_mod
 from dedloc_tpu.telemetry import registry as telemetry
 from dedloc_tpu.testing import faults
+from dedloc_tpu.utils.aio import keep_task
 from dedloc_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -231,7 +232,10 @@ class RPCServer:
                     # reply to a call_over we piped down this connection
                     self._route_reply(msg, writer)
                     continue
-                asyncio.ensure_future(self._dispatch(peer, msg, writer))
+                # retained + exception-logged (utils/aio): a handler
+                # task dying silently would swallow the request forever
+                keep_task(self._dispatch(peer, msg, writer),
+                          name="rpc dispatch", log=logger)
         finally:
             self._writers.discard(writer)
             writer.close()
@@ -359,7 +363,8 @@ class RPCClient:
                 if msg.get("method") is not None:
                     # relayed request piped to us down our own outbound
                     # connection (circuit relay): serve it and reply in-band
-                    asyncio.ensure_future(self._dispatch_reverse(endpoint, msg))
+                    keep_task(self._dispatch_reverse(endpoint, msg),
+                              name="reverse dispatch", log=logger)
                     continue
                 fut = self._pending.get(endpoint, {}).pop(msg.get("id"), None)
                 if fut is not None and not fut.done():

@@ -8,9 +8,10 @@ from __future__ import annotations
 
 import hashlib
 import os
-import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
+
+from dedloc_tpu.core import timeutils
 
 ID_BITS = 256
 
@@ -49,7 +50,7 @@ Endpoint = Tuple[str, int]  # (host, port)
 class NodeInfo:
     node_id: DHTID
     endpoint: Endpoint
-    last_seen: float = field(default_factory=time.monotonic)
+    last_seen: float = field(default_factory=timeutils.monotonic)
 
 
 class KBucket:
@@ -59,7 +60,7 @@ class KBucket:
         self.replacement_cache: Dict[DHTID, NodeInfo] = {}
         # when this bucket's range last saw lookup/refresh activity — the
         # Kademlia bucket-refresh trigger (DHTNode.run_maintenance)
-        self.last_refreshed: float = time.monotonic()
+        self.last_refreshed: float = timeutils.monotonic()
 
     def covers(self, node_id: int) -> bool:
         return self.lower <= node_id < self.upper
@@ -130,7 +131,7 @@ class RoutingTable:
 
     def mark_range_refreshed(self, target: int) -> None:
         """Record lookup activity for the bucket covering ``target``."""
-        self._bucket_for(target).last_refreshed = time.monotonic()
+        self._bucket_for(target).last_refreshed = timeutils.monotonic()
 
     def remove_node(self, node_id: DHTID) -> None:
         self._bucket_for(node_id).remove(node_id)

@@ -37,7 +37,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from dedloc_tpu.core import timeutils
 from dedloc_tpu.core.config import parse_config
+from dedloc_tpu.core.timeutils import get_dht_time
 from dedloc_tpu.testing.faults import FaultSchedule
 from dedloc_tpu.utils.logging import get_logger
 
@@ -108,7 +110,9 @@ class LocalFleet:
                 env=env, stdout=log, stderr=subprocess.STDOUT,
             )
         self.procs[name] = proc
-        self.events.append({"t": time.time(), "event": "spawn", "peer": name})
+        self.events.append(
+            {"t": get_dht_time(), "event": "spawn", "peer": name}
+        )
         logger.info(f"spawned {name} (pid {proc.pid})")
 
     def _common_flags(self, initial_peers: bool = True) -> List[str]:
@@ -198,7 +202,7 @@ class LocalFleet:
         self.procs[victim].kill()
         self.procs[victim].wait()
         self.events.append(
-            {"t": time.time(), "event": "preempt", "peer": victim}
+            {"t": get_dht_time(), "event": "preempt", "peer": victim}
         )
         logger.info(f"preempted {victim}")
         return victim
@@ -212,15 +216,18 @@ class LocalFleet:
         """Supervise until ``duration`` elapses; churn + respawn throughout
         (the notebook's spot-respawn loop)."""
         a = self.args
-        deadline = time.time() + a.duration if a.duration else None
+        deadline = (
+            timeutils.monotonic() + a.duration if a.duration else None
+        )
         next_churn = (
-            time.time() + a.churn_interval if a.churn_interval else None
+            timeutils.monotonic() + a.churn_interval
+            if a.churn_interval else None
         )
         pending_respawn: List[tuple] = []  # (respawn_at, name)
         try:
-            while deadline is None or time.time() < deadline:
+            while deadline is None or timeutils.monotonic() < deadline:
                 time.sleep(0.2)
-                now = time.time()
+                now = timeutils.monotonic()
                 if next_churn is not None and now >= next_churn:
                     victim = self.preempt_random_trainer()
                     if victim is not None:
@@ -249,8 +256,8 @@ class LocalFleet:
                         crashes = self._crash_counts.get(name, 0) + 1
                         self._crash_counts[name] = crashes
                         self.events.append(
-                            {"t": now, "event": "died", "peer": name,
-                             "returncode": proc.returncode}
+                            {"t": get_dht_time(), "event": "died",
+                             "peer": name, "returncode": proc.returncode}
                         )
                         if crashes > self.max_crash_respawns:
                             logger.warning(

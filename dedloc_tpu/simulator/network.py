@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from dedloc_tpu.dht.transport import Endpoint, Listener, Transport
 from dedloc_tpu.testing import faults
+from dedloc_tpu.utils.aio import keep_task
 from dedloc_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -319,9 +320,11 @@ class SimNetwork:
         self._conns_by_host.setdefault(src_host, set()).add(conn)
         self._conns_by_host.setdefault(endpoint[0], set()).add(conn)
         # the acceptor's callback runs as its own task, like
-        # asyncio.start_server's protocol factory
-        asyncio.ensure_future(
-            listener.on_connection(conn.readers[1], conn.writers[1])
+        # asyncio.start_server's protocol factory (retained +
+        # exception-logged so a dead acceptor is visible, utils/aio)
+        keep_task(
+            listener.on_connection(conn.readers[1], conn.writers[1]),
+            name="sim acceptor", log=logger,
         )
         return conn.readers[0], conn.writers[0]
 
@@ -352,7 +355,8 @@ class SimNetwork:
                     if fault.action == "kill" and fault.callback is not None:
                         result = fault.callback()
                         if inspect.isawaitable(result):
-                            asyncio.ensure_future(result)
+                            keep_task(result, name="kill-fault callback",
+                                      log=logger)
                     self.stats["fault_drops"] += 1
                     loop.call_soon(conn.reset)
                     return

@@ -30,6 +30,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Tuple
 
+from dedloc_tpu.telemetry import events
+
 # ---------------------------------------------------------------------------
 # Shared rule thresholds: ONE definition consumed by the swarm-health verdict
 # below, the live watchdog (telemetry/watch.py) and the runlog_summary
@@ -55,26 +57,29 @@ RULE_THRESHOLDS: Dict[str, float] = {
     "behind_steps": 2.0,
 }
 
-# counter names lifted from the instrumented seams; a missing key reads 0.0
-# so peers running older builds (no telemetry tail) still aggregate
+# counter names lifted from the instrumented seams — imported from the
+# generated telemetry catalog (telemetry/events.py) so the dedlint schema
+# checker guards ONE definition instead of duplicated string literals; a
+# missing key reads 0.0 so peers running older builds (no telemetry tail)
+# still aggregate
 _PEER_COUNTERS = {
-    "rpc_failures": "rpc.client.failures",
-    "rpc_calls": "rpc.client.calls",
+    "rpc_failures": events.RPC_CLIENT_FAILURES,
+    "rpc_calls": events.RPC_CLIENT_CALLS,
     # connection-death count: with rpc_calls it gives the per-peer loss
     # rate a telemetry-fitted simulator model (dedloc_tpu/twin) reads
-    "conns_lost": "rpc.conns_lost",
-    "rounds_attempted": "mm.rounds_attempted",
-    "rounds_formed": "mm.rounds_formed",
-    "rounds_aborted": "mm.rounds_aborted",
-    "join_failures": "mm.join_failures",
-    "leader_changes": "mm.leader_changes",
-    "state_sync_attempts": "state_sync.attempts",
-    "state_sync_retries": "state_sync.retries",
-    "state_sync_failures": "state_sync.failures",
-    "checksum_failures": "state_sync.checksum_failures",
-    "grads_dropped": "opt.grads_dropped",
-    "grads_applied": "opt.grads_applied",
-    "faults_injected": "faults.applied",
+    "conns_lost": events.RPC_CONNS_LOST,
+    "rounds_attempted": events.MM_ROUNDS_ATTEMPTED,
+    "rounds_formed": events.MM_ROUNDS_FORMED,
+    "rounds_aborted": events.MM_ROUNDS_ABORTED,
+    "join_failures": events.MM_JOIN_FAILURES,
+    "leader_changes": events.MM_LEADER_CHANGES,
+    "state_sync_attempts": events.STATE_SYNC_ATTEMPTS,
+    "state_sync_retries": events.STATE_SYNC_RETRIES,
+    "state_sync_failures": events.STATE_SYNC_FAILURES,
+    "checksum_failures": events.STATE_SYNC_CHECKSUM_FAILURES,
+    "grads_dropped": events.OPT_GRADS_DROPPED,
+    "grads_applied": events.OPT_GRADS_APPLIED,
+    "faults_injected": events.FAULTS_APPLIED,
 }
 
 
@@ -90,19 +95,19 @@ def _peer_entry(m, current_step: int) -> Dict:
         entry["step_time_ms"] = m.step_time_ms
     for out_key, counter in _PEER_COUNTERS.items():
         entry[out_key] = float(t.get(counter, 0.0))
-    form = t.get("mm.form_group.mean")
+    form = t.get(events.MM_FORM_GROUP + ".mean")
     if form is not None:
         entry["round_formation_s"] = float(form)
         # the matching sample count lets a streaming consumer (the
         # watchdog) recover the PER-WINDOW mean between two folds from
         # cumulative statistics: mean_w = (c2*m2 - c1*m1) / (c2 - c1)
-        count = t.get("mm.form_group.count")
+        count = t.get(events.MM_FORM_GROUP + ".count")
         if count is not None:
             entry["round_formation_count"] = float(count)
-    round_dur = t.get("avg.round.mean")
+    round_dur = t.get(events.AVG_ROUND + ".mean")
     if round_dur is not None:
         entry["round_s"] = float(round_dur)
-        count = t.get("avg.round.count")
+        count = t.get(events.AVG_ROUND + ".count")
         if count is not None:
             entry["round_count"] = float(count)
     # step-phase flight recorder (telemetry/steps.py): per-phase mean
@@ -130,19 +135,19 @@ def _peer_entry(m, current_step: int) -> Dict:
             # per-phase sample counts: the windowing companion to the
             # cumulative means (same rationale as round_count above)
             entry["phase_counts"] = phase_counts
-    mfu = t.get("step.mfu")
+    mfu = t.get(events.STEP_MFU)
     if mfu is not None:
         entry["mfu"] = float(mfu)
     # mean verified checkpoint-fetch goodput this peer measured against its
     # providers — an uplink-bandwidth signal for the twin fitter that
     # exists even on fleets that never ran a single averaging round
-    provider_goodput = t.get("ckpt.provider_goodput.mean")
+    provider_goodput = t.get(events.CKPT_PROVIDER_GOODPUT + ".mean")
     if provider_goodput is not None:
         entry["provider_goodput_bps"] = float(provider_goodput)
     # overlap ledger (collaborative optimizer): cumulative hidden/exposed
     # averaging seconds → lifetime overlap efficiency for this peer
-    hidden = float(t.get("opt.overlap_hidden_s", 0.0))
-    exposed = float(t.get("opt.overlap_exposed_s", 0.0))
+    hidden = float(t.get(events.OPT_OVERLAP_HIDDEN_S, 0.0))
+    exposed = float(t.get(events.OPT_OVERLAP_EXPOSED_S, 0.0))
     if hidden or exposed:
         entry["overlap_hidden_s"] = hidden
         entry["overlap_exposed_s"] = exposed

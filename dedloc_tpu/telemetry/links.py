@@ -219,6 +219,9 @@ class LinkTable:
         """Flat ``{"link.<dst>.<field>": value}`` view of the top-K links —
         the shape that rides the metrics-bus telemetry snapshot (every value
         a float; ``dst`` strings live in the key)."""
+        # dedlint: emits=link.* — these snapshot keys are built by hand
+        # below, not through a registry call, so the telemetry catalog
+        # learns the family from this declaration
         out: Dict[str, float] = {}
         for link in self.top(top_k):
             rec = link.record()

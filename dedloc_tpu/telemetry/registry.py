@@ -40,7 +40,6 @@ import hashlib
 import json
 import math
 import threading
-import time
 import uuid
 from collections import deque
 from contextlib import contextmanager
@@ -51,11 +50,13 @@ from dedloc_tpu.core.timeutils import get_dht_time
 
 
 def monotonic_clock() -> float:
-    """Monotonic duration clock that also honours the FakeClock offset:
-    ``FakeClock.advance(n)`` moves it forward by ``n`` exactly, so scripted
-    fault scenarios produce deterministic span durations while production
-    (offset 0) gets plain ``time.monotonic``."""
-    return time.monotonic() + timeutils._dht_time_offset
+    """Monotonic duration clock that also honours the FakeClock offset and
+    a simulator-installed virtual time source: ``FakeClock.advance(n)``
+    moves it forward by ``n`` exactly, so scripted fault scenarios produce
+    deterministic span durations, while production (offset 0, no source)
+    gets plain ``time.monotonic``. Alias of ``timeutils.monotonic`` — kept
+    as the registry's public name for clock injection."""
+    return timeutils.monotonic()
 
 
 # ---------------------------------------------------------------------------

@@ -547,7 +547,10 @@ class SwarmWatch:
                 w = _windowed(prev.get(key), pair)
                 if w is None:
                     continue
-                if key.startswith("phase."):
+                # dedlint: disable=schema-consumed-unknown — "phase." is
+                # the fold's OWN per-peer stat namespace (health records),
+                # not a telemetry emit name
+                if key.startswith("phase."):  # dedlint: disable=schema-consumed-unknown
                     windowed_phase.setdefault(label, {})[
                         key[len("phase."):]
                     ] = w

@@ -51,6 +51,8 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from dedloc_tpu.simulator.network import LinkSpec
+# the catalog module is aliased: this file's row lists are named ``events``
+from dedloc_tpu.telemetry import events as ev
 from dedloc_tpu.utils.logging import get_logger
 
 # the SAME nearest-rank percentile the simulator's reports use
@@ -246,14 +248,14 @@ def fit_twin(rows: List[Dict[str, Any]],
     # ONLY when no per-peer event log contributed real ones, so feeding
     # both never double-counts a round.
     rounds_from_folds = 0
-    if not any(r.get("event") == "avg.round" for r in events):
+    if not any(r.get("event") == ev.AVG_ROUND for r in events):
         for row in health_rows:
             fold_t = row.get("time")
             for rd in row["swarm_health"].get("rounds") or []:
                 if not isinstance(rd, dict) or rd.get("dur_s") is None:
                     continue
                 synthetic = {
-                    "event": "avg.round",
+                    "event": ev.AVG_ROUND,
                     "peer": safe_label(rd.get("peer", "?")),
                     "round_id": rd.get("round_id"),
                     "dur_s": float(rd["dur_s"]),
@@ -284,7 +286,7 @@ def fit_twin(rows: List[Dict[str, Any]],
 
     endpoint_map: Dict[str, str] = {}
     for r in events:
-        if r.get("event") == "peer.endpoint" and r.get("endpoint"):
+        if r.get("event") == ev.PEER_ENDPOINT and r.get("endpoint"):
             endpoint_map[str(r["endpoint"])] = safe_label(r.get("peer", "?"))
     for health in healths:
         topo = health.get("topology") or {}
@@ -307,9 +309,9 @@ def fit_twin(rows: List[Dict[str, Any]],
     for r in events:
         name = r.get("event")
         src = str(r.get("peer", "?"))
-        if name == "link.stats" and r.get("dst"):
+        if name == ev.LINK_STATS and r.get("dst"):
             latest_stats[(src, str(r["dst"]))] = r
-        elif name == "allreduce.link" and r.get("dst"):
+        elif name == ev.ALLREDUCE_LINK and r.get("dst"):
             dst_label = _resolve_label(str(r["dst"]), labels, endpoint_map)
             if dst_label is None:
                 unresolved_dsts += 1
@@ -329,7 +331,7 @@ def fit_twin(rows: List[Dict[str, Any]],
                     acc["sent"] += sent
                     acc["chunks"] += float(r.get("chunks_sent", 0.0))
                     acc["dsts"] += 1.0
-        elif name == "rpc.conn_lost" and r.get("endpoint"):
+        elif name == ev.RPC_CONN_LOST and r.get("endpoint"):
             dst_label = _resolve_label(
                 str(r["endpoint"]), labels, endpoint_map
             )
@@ -405,7 +407,7 @@ def fit_twin(rows: List[Dict[str, Any]],
     rounds_by_id: Dict[str, List[Dict[str, Any]]] = {}
     round_dur: Dict[Tuple[str, str], float] = {}
     for r in events:
-        if r.get("event") == "avg.round" and r.get("round_id"):
+        if r.get("event") == ev.AVG_ROUND and r.get("round_id"):
             rid = str(r["round_id"])
             rounds_by_id.setdefault(rid, []).append(r)
             if r.get("dur_s") is not None and r.get("ok") is not False:
@@ -591,7 +593,7 @@ def fit_twin(rows: List[Dict[str, Any]],
     # ------------------------------------------------------- per-peer fits
     step_records: Dict[str, List[Dict[str, Any]]] = {}
     for r in events:
-        if r.get("event") == "step.record":
+        if r.get("event") == ev.STEP_RECORD:
             step_records.setdefault(str(r.get("peer", "?")), []).append(r)
     health_phases: Dict[str, Dict[str, float]] = {}
     for health in healths:  # newest record wins per peer
@@ -655,7 +657,7 @@ def fit_twin(rows: List[Dict[str, Any]],
     ]
     formation = [
         float(r["dur_s"]) for r in events
-        if r.get("event") == "mm.form_group"
+        if r.get("event") == ev.MM_FORM_GROUP
         and r.get("dur_s") is not None and r.get("ok") is not False
     ]
     span_bytes = _median(
@@ -673,13 +675,13 @@ def fit_twin(rows: List[Dict[str, Any]],
             0.0,
         )
     ledgers = [
-        r for r in events if r.get("event") == "opt.overlap_ledger"
+        r for r in events if r.get("event") == ev.OPT_OVERLAP_LEDGER
     ]
     hidden = sum(float(r.get("hidden_s", 0.0)) for r in ledgers)
     exposed = sum(float(r.get("exposed_s", 0.0)) for r in ledgers)
     restores = [
         r for r in events
-        if r.get("event") == "ckpt.restore" and r.get("ok")
+        if r.get("event") == ev.CKPT_RESTORE and r.get("ok")
     ]
     # round cadence: gaps between successive round STARTS (event t stamps
     # are span exits; subtract the duration)
@@ -705,7 +707,7 @@ def fit_twin(rows: List[Dict[str, Any]],
     # a recorded run config (the driver's run.config event; a real fleet's
     # logged flags) beats inference — config is KNOWN, only physics needs
     # fitting. The newest record wins; estimator values above fill gaps.
-    config_events = [r for r in events if r.get("event") == "run.config"]
+    config_events = [r for r in events if r.get("event") == ev.RUN_CONFIG]
     config_fields = 0
     if config_events:
         newest = config_events[-1]

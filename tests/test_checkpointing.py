@@ -217,6 +217,31 @@ def test_store_latest_manifest_skips_corrupt_newest(rng, tmp_path):
 # ------------------------------------------------------------------ catalog
 
 
+
+def _wait_for_catalog(dht, name, min_entries=1, timeout=15.0):
+    """Deflake helper: catalog announcements are published fire-and-forget,
+    so a fast joiner can start restoring before its own DHT view holds the
+    record(s) and (correctly) fall back to the blob path — tests asserting
+    WHICH path carried the restore must wait for the announcement first."""
+    import time as _time
+
+    from dedloc_tpu.checkpointing.catalog import catalog_key
+
+    deadline = _time.time() + timeout
+    while _time.time() < deadline:
+        entry = dht.get(catalog_key(name), latest=True)
+        if (
+            entry is not None
+            and hasattr(entry.value, "items")
+            and len(list(entry.value.items())) >= min_entries
+        ):
+            return
+        _time.sleep(0.05)
+    raise AssertionError(
+        f"catalog for {name!r} never showed {min_entries} announcement(s)"
+    )
+
+
 def _announcement(step=4, num_shards=5, port=1234, shards=None, digest=None):
     return CheckpointAnnouncement(
         step=step,
@@ -635,29 +660,11 @@ def test_fault_injected_multi_peer_restore(rng, tmp_path):
             provider.set_shared_state(tree, {"step": 42, "local_step": 42})
             provider.publish_state_provider(expiration=60.0)
 
-        # deflake: catalog announcements are published fire-and-forget, so
-        # the joiner can race a half-propagated catalog, see provider A as
-        # the ONLY announcer, and (correctly) fail over to the blob path
-        # when A dies — wait until the joiner's own DHT view holds BOTH
-        # announcements before starting the restore under faults
-        import time as _time
-
-        from dedloc_tpu.checkpointing.catalog import catalog_key
-
-        deadline = _time.time() + 15.0
-        while _time.time() < deadline:
-            entry = dhts[2].get(catalog_key("accept"), latest=True)
-            if (
-                entry is not None
-                and hasattr(entry.value, "items")
-                and len(list(entry.value.items())) >= 2
-            ):
-                break
-            _time.sleep(0.05)
-        else:
-            raise AssertionError(
-                "catalog never showed both providers to the joiner"
-            )
+        # deflake: wait until the joiner's own DHT view holds BOTH
+        # announcements before starting the restore under faults (a
+        # half-propagated catalog would show provider A as the only
+        # announcer and correctly fall back to blob when A dies)
+        _wait_for_catalog(dhts[2], "accept", min_entries=2)
 
         served_a = {"n": 0}
 
@@ -793,6 +800,12 @@ def test_sharded_restore_preferred_over_blob(rng):
     try:
         provider.set_shared_state(tree, {"step": 9, "local_step": 9})
         provider.publish_state_provider(expiration=60.0)
+
+        # deflake (the multi-peer test's race, single-provider flavor):
+        # the sharded-preference assertion must not race the fire-and-
+        # forget catalog announcement
+        _wait_for_catalog(dhts[1], "prefer")
+
         result = joiner.load_state_from_peers(timeout=20.0)
         assert result is not None
         _metadata, restored = result

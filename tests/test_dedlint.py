@@ -1,0 +1,654 @@
+"""dedlint static-analysis suite (tools/dedlint, ISSUE 14).
+
+Golden fixtures per rule (one clean, one violating), baseline-suppression
+semantics (counts, staleness, malformed-warn-not-wedge), exit codes
+matching bench_gate/t1_budget conventions, and THE tier-1 gate: the
+shipped tree plus the checked-in baseline must produce zero new findings.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+import tools.dedlint as dedlint  # noqa: E402
+from tools.dedlint import (  # noqa: E402
+    checks_async,
+    checks_clock,
+    checks_locks,
+    checks_schema,
+)
+from tools.dedlint.__main__ import main as dedlint_main  # noqa: E402
+from tools.dedlint.core import (  # noqa: E402
+    ScannedFile,
+    gate_findings,
+    load_baseline,
+)
+
+
+def scanned(rel: str, src: str) -> ScannedFile:
+    return ScannedFile(f"/fixture/{rel}", rel, textwrap.dedent(src))
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------ clock rules
+
+
+def test_clock_flags_raw_clocks_in_sim_reachable_modules():
+    bad = scanned(
+        "dedloc_tpu/averaging/x.py",
+        """
+        import time
+        import time as _time
+        from datetime import datetime
+
+        def deadline():
+            return time.monotonic() + 5.0
+
+        def aliased():
+            return _time.perf_counter()
+
+        def wall():
+            return datetime.now()
+        """,
+    )
+    findings = checks_clock.check([bad])
+    assert sorted(f.detail for f in findings) == [
+        "datetime.datetime.now", "time.monotonic", "time.perf_counter",
+    ]
+    assert {f.rule for f in findings} == {"clock-wall", "clock-monotonic"}
+
+
+def test_clock_clean_fixture_and_out_of_scope_module_pass():
+    clean = scanned(
+        "dedloc_tpu/averaging/x.py",
+        """
+        from dedloc_tpu.core import timeutils
+        from dedloc_tpu.core.timeutils import get_dht_time
+
+        def deadline():
+            return timeutils.monotonic() + 5.0
+
+        def stamp():
+            return get_dht_time()
+        """,
+    )
+    # same raw clocks OUTSIDE the simulator-reachable dirs: not this rule's
+    # business (roles/ supervises real subprocesses)
+    out_of_scope = scanned(
+        "dedloc_tpu/roles/x.py", "import time\nT0 = time.monotonic()\n"
+    )
+    assert checks_clock.check([clean, out_of_scope]) == []
+
+
+def test_clock_flags_bare_reference_passed_as_callable():
+    # default_factory=time.monotonic smuggles the clock in without a Call
+    bad = scanned(
+        "dedloc_tpu/dht/x.py",
+        """
+        import time
+        from dataclasses import dataclass, field
+
+        @dataclass
+        class Info:
+            last_seen: float = field(default_factory=time.monotonic)
+        """,
+    )
+    assert rules_of(checks_clock.check([bad])) == ["clock-monotonic"]
+
+
+def test_clock_bare_sleep_polling_wall_deadline():
+    bad = scanned(
+        "dedloc_tpu/dht/x.py",
+        """
+        import asyncio
+        import time
+
+        async def poll(deadline):
+            while time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+        """,
+    )
+    rules = rules_of(checks_clock.check([bad]))
+    assert "clock-bare-sleep" in rules and "clock-monotonic" in rules
+    # the same sleep against an approved clock is fine
+    ok = scanned(
+        "dedloc_tpu/dht/x.py",
+        """
+        import asyncio
+        from dedloc_tpu.core import timeutils
+
+        async def poll(deadline):
+            while timeutils.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+        """,
+    )
+    assert checks_clock.check([ok]) == []
+
+
+def test_clock_inline_suppression_pragma():
+    sup = scanned(
+        "dedloc_tpu/checkpointing/x.py",
+        """
+        import time
+
+        def sweep():
+            return time.time()  # dedlint: disable=clock-wall
+        """,
+    )
+    assert checks_clock.check([sup]) == []
+
+
+def test_clock_suppression_on_multiline_statement_first_line():
+    # the flagged node anchors on a CONTINUATION line; the documented
+    # contract is that the statement's first line may carry the pragma
+    sup = scanned(
+        "dedloc_tpu/checkpointing/x.py",
+        """
+        import time
+
+        def stamp():
+            return round(  # dedlint: disable=clock-monotonic
+                time.monotonic(),
+                3,
+            )
+        """,
+    )
+    assert checks_clock.check([sup]) == []
+
+
+def test_clock_bare_sleep_skips_callbacks_defined_in_loop_body():
+    # a callback DEFINED inside the poll loop runs later on its own
+    # schedule — its sleep never polls this loop's deadline
+    src = scanned(
+        "dedloc_tpu/dht/x.py",
+        """
+        import asyncio
+        import time
+
+        async def outer(deadline, register):
+            while time.monotonic() < deadline:  # dedlint: disable=clock-monotonic
+                async def cb():
+                    await asyncio.sleep(1.0)
+                register(cb)
+                await asyncio.sleep(0.05)
+        """,
+    )
+    findings = checks_clock.check([src])
+    sleeps = [f for f in findings if f.rule == "clock-bare-sleep"]
+    # only the loop's own sleep (line 10), not the callback's (line 8)
+    assert [f.line for f in sleeps] == [10]
+
+
+# ------------------------------------------------------------ async rules
+
+
+def test_async_orphan_task_flagged_and_retained_not():
+    bad = scanned(
+        "dedloc_tpu/dht/x.py",
+        """
+        import asyncio
+
+        async def serve(handler):
+            asyncio.ensure_future(handler())
+        """,
+    )
+    assert rules_of(checks_async.check([bad])) == ["async-orphan-task"]
+    ok = scanned(
+        "dedloc_tpu/dht/x.py",
+        """
+        import asyncio
+        from dedloc_tpu.utils.aio import keep_task
+
+        async def serve(handler, tasks):
+            t = asyncio.ensure_future(handler())
+            tasks.append(t)
+            keep_task(handler())
+            await asyncio.create_task(handler())
+        """,
+    )
+    assert checks_async.check([ok]) == []
+
+
+def test_async_blocking_calls_only_inside_coroutines():
+    bad = scanned(
+        "dedloc_tpu/averaging/x.py",
+        """
+        import time
+
+        async def wait():
+            time.sleep(1.0)
+
+        async def read(path):
+            with open(path) as f:
+                return f.read()
+        """,
+    )
+    assert sorted(f.detail for f in checks_async.check([bad])) == [
+        "open", "time.sleep",
+    ]
+    ok = scanned(
+        "dedloc_tpu/averaging/x.py",
+        """
+        import time
+
+        def sync_helper():
+            time.sleep(1.0)
+
+        async def wait(loop):
+            # a nested SYNC def is executor-bound, not coroutine code
+            def blocking():
+                time.sleep(1.0)
+            await loop.run_in_executor(None, blocking)
+        """,
+    )
+    assert checks_async.check([ok]) == []
+
+
+# ------------------------------------------------------------- lock rules
+
+
+_LOCK_FIXTURE = """
+    import threading
+
+    class Shared:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0.0  # __init__ is pre-publication: exempt
+
+        def inc(self):
+            with self._lock:
+                self.count += 1
+
+        {extra}
+"""
+
+
+def test_lock_unguarded_mutation_flagged():
+    bad = scanned(
+        "dedloc_tpu/telemetry/x.py",
+        _LOCK_FIXTURE.format(
+            extra="def reset(self):\n            self.count = 0.0"
+        ),
+    )
+    findings = checks_locks.check([bad])
+    assert [f.detail for f in findings] == ["Shared.count"]
+
+
+def test_lock_private_helper_called_under_lock_is_inferred():
+    ok = scanned(
+        "dedloc_tpu/telemetry/x.py",
+        _LOCK_FIXTURE.format(
+            extra=(
+                "def flush(self):\n"
+                "            with self._lock:\n"
+                "                self._reset()\n\n"
+                "        def _reset(self):\n"
+                "            self.count = 0.0"
+            )
+        ),
+    )
+    assert checks_locks.check([ok]) == []
+
+
+def test_lock_method_passed_as_callback_not_inferred():
+    # the only DIRECT call site is under the lock, but the bare reference
+    # escapes to deferred execution — inference must not cover _reset
+    bad = scanned(
+        "dedloc_tpu/telemetry/x.py",
+        _LOCK_FIXTURE.format(
+            extra=(
+                "def flush(self):\n"
+                "            with self._lock:\n"
+                "                self._reset()\n\n"
+                "        def arm(self, loop):\n"
+                "            loop.call_soon(self._reset)\n\n"
+                "        def _reset(self):\n"
+                "            self.count = 0.0"
+            )
+        ),
+    )
+    assert [f.detail for f in checks_locks.check([bad])] == ["Shared.count"]
+
+
+def test_lock_closure_inside_locked_method_not_inferred():
+    # a callback defined under the lock runs LATER on another thread
+    bad = scanned(
+        "dedloc_tpu/telemetry/x.py",
+        _LOCK_FIXTURE.format(
+            extra=(
+                "def arm(self, fut):\n"
+                "            with self._lock:\n"
+                "                def _done(_f):\n"
+                "                    self.count = 0.0\n"
+                "                fut.add_done_callback(_done)"
+            )
+        ),
+    )
+    assert [f.detail for f in checks_locks.check([bad])] == ["Shared.count"]
+
+
+# ----------------------------------------------------------- schema rules
+
+
+def test_schema_emit_extraction_literals_fstrings_and_pragmas():
+    src = scanned(
+        "dedloc_tpu/averaging/x.py",
+        """
+        def instrument(tele, name):
+            tele.counter("mm.rounds_attempted").inc()
+            tele.histogram(f"step.phase.{name}").observe(1.0)
+            tele.event(name)  # dedlint: emits=custom.family.*
+            with tele.span("avg.round"):
+                pass
+        """,
+    )
+    catalog, findings = checks_schema.collect_emits([src])
+    assert findings == []
+    assert catalog.names["mm.rounds_attempted"] == {"counter"}
+    assert catalog.names["avg.round"] == {"span"}
+    assert "step.phase." in catalog.prefixes
+    assert "custom.family." in catalog.prefixes
+    assert catalog.known_key("avg.round.mean"), "span -> histogram suffix"
+    # kind-prefixed pragma: a declared SPAN also owns its snapshot suffixes
+    kinded = scanned(
+        "dedloc_tpu/averaging/y.py",
+        "def g(tele, n):\n"
+        "    tele.span(n)  # dedlint: emits=span:x.serve,plain.event\n",
+    )
+    cat2, dyn = checks_schema.collect_emits([kinded])
+    assert dyn == []
+    assert cat2.names["x.serve"] == {"span"}
+    assert cat2.known_key("x.serve.mean")
+    assert cat2.names["plain.event"] == {"event"}
+    assert not cat2.known_key("plain.event.mean")
+    # undeclared dynamic name IS a finding
+    bad = scanned(
+        "dedloc_tpu/averaging/x.py",
+        "def f(tele, name):\n    tele.counter(name).inc()\n",
+    )
+    _cat, findings = checks_schema.collect_emits([bad])
+    assert rules_of(findings) == ["schema-dynamic-name"]
+
+
+def test_schema_consumed_unknown_key_flagged_known_pass():
+    emitter = scanned(
+        "dedloc_tpu/averaging/x.py",
+        'def f(tele):\n    tele.counter("mm.rounds_formed").inc()\n',
+    )
+    consumer = scanned(
+        "dedloc_tpu/telemetry/health.py",
+        """
+        def fold(t):
+            ok = t.get("mm.rounds_formed")
+            bad = t.get("mm.rounds_fromed")
+            return ok, bad
+        """,
+    )
+    catalog, _ = checks_schema.collect_emits([emitter])
+    findings = checks_schema.check_consumers([emitter, consumer], catalog)
+    assert [f.detail for f in findings] == ["mm.rounds_fromed"]
+
+
+def test_schema_consumed_prefix_without_trailing_dot_still_checked():
+    emitter = scanned(
+        "dedloc_tpu/averaging/x.py",
+        'def f(tele):\n    tele.counter("mm.rounds_formed").inc()\n',
+    )
+    consumer = scanned(
+        "dedloc_tpu/telemetry/health.py",
+        """
+        def fold(key, line):
+            a = key.startswith("mm.rounds_formed")
+            b = key.startswith("mm.rounds_fromed")
+            c = line.startswith("#")
+            return a, b, c
+        """,
+    )
+    catalog, _ = checks_schema.collect_emits([emitter])
+    findings = checks_schema.check_consumers([emitter, consumer], catalog)
+    # the typo'd prefix is a finding; the valid one and the non-key-shaped
+    # "#" literal are not
+    assert [f.detail for f in findings] == ["mm.rounds_fromed*"]
+
+
+def test_schema_fault_point_unknown():
+    prod = scanned(
+        "dedloc_tpu/dht/x.py",
+        'def f(faults):\n    faults.fire("rpc.client.call", method="m")\n',
+    )
+    test_ok = scanned(
+        "tests/test_x.py",
+        'def t(s):\n    s.inject("rpc.client.call", "drop")\n',
+    )
+    test_bad = scanned(
+        "tests/test_x.py",
+        'def t(s):\n    s.inject("rpc.client.dial", "drop")\n',
+    )
+    assert checks_schema.check_fault_points([prod, test_ok]) == []
+    findings = checks_schema.check_fault_points([prod, test_bad])
+    assert [f.detail for f in findings] == ["rpc.client.dial"]
+
+
+def test_schema_config_flag_unknown(tmp_path):
+    config = scanned(
+        "dedloc_tpu/core/config.py",
+        """
+        from dataclasses import dataclass, field
+
+        @dataclass
+        class DHTArguments:
+            listen_port: int = 0
+
+        @dataclass
+        class Tree:
+            dht: DHTArguments = field(default_factory=DHTArguments)
+        """,
+    )
+    test_file = scanned(
+        "tests/test_x.py",
+        # fixture flag, hence the pragma on THIS line too:
+        'FLAGS = ["--dht.listen_port", "0", "--dht.listen_prot", "1"]\n',  # dedlint: disable=schema-config-flag-unknown
+    )
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "x.md").write_text(
+        "Use ``--dht.listen_port`` (not --dht.portx.y).\n"  # dedlint: disable=schema-config-flag-unknown
+    )
+    findings = checks_schema.check_config_flags(
+        [config, test_file], str(tmp_path)
+    )
+    assert sorted(f.detail for f in findings) == [
+        "dht.listen_prot", "dht.portx.y",
+    ]
+
+
+# ----------------------------------------------- baseline gate semantics
+
+
+def test_baseline_counts_cover_and_ratchet():
+    bad = scanned(
+        "dedloc_tpu/averaging/x.py",
+        """
+        import time
+
+        def a():
+            return time.monotonic()
+        """,
+    )
+    findings = checks_clock.check([bad])
+    assert len(findings) == 1
+    key = findings[0].key
+    new, stale = gate_findings(findings, {key: 1})
+    assert new == [] and stale == []
+    # a SECOND identical violation in the same scope exceeds the count
+    bad2 = scanned(
+        "dedloc_tpu/averaging/x.py",
+        """
+        import time
+
+        def a():
+            t = time.monotonic()
+            return time.monotonic() - t
+        """,
+    )
+    findings2 = checks_clock.check([bad2])
+    new, _ = gate_findings(findings2, {key: 1})
+    assert len(new) == 1, "count semantics: baselined 1, found 2 -> 1 new"
+    # the same ratchet must hold for two violations on ONE line (columns
+    # keep them distinct through the runner's dedupe)
+    one_line = scanned(
+        "dedloc_tpu/averaging/x.py",
+        """
+        import time
+
+        def a():
+            return time.monotonic(), time.monotonic()
+        """,
+    )
+    findings3 = checks_clock.check([one_line])
+    assert len(findings3) == 2 and findings3[0].col != findings3[1].col
+    new, _ = gate_findings(findings3, {key: 1})
+    assert len(new) == 1, "same-line second violation must gate"
+    # fixed violation: the baseline entry is stale and must be deleted
+    new, stale = gate_findings([], {key: 1})
+    assert new == [] and len(stale) == 1
+    assert "delete it" in stale[0]
+    # PARTIALLY fixed (baselined 2, found 1): deleting the entry would
+    # un-grandfather the survivor — the advice is to lower the count
+    new, stale = gate_findings(findings, {key: 2})
+    assert new == [] and len(stale) == 1
+    assert "lower its count to 1" in stale[0] and "delete" not in stale[0]
+
+
+def test_baseline_zeroed_entry_is_deleted_not_promoted(tmp_path):
+    """A count edited to 0 un-grandfathers the violation (ratchet, not a
+    mute button): the entry loads as deleted with a warning, so the
+    finding gates again instead of staying silently covered."""
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"clock-monotonic::a.py::f::time.monotonic": 0}))
+    baseline, warnings = load_baseline(str(path))
+    assert baseline == {}
+    assert any("treated as deleted" in w for w in warnings)
+
+
+def _write_violating_tree(root: Path) -> None:
+    pkg = root / "dedloc_tpu" / "averaging"
+    pkg.mkdir(parents=True)
+    (pkg / "x.py").write_text(
+        "import time\n\n\ndef f():\n    return time.monotonic()\n"
+    )
+
+
+def test_cli_gate_exit_codes_on_synthetic_roots(tmp_path, capsys):
+    _write_violating_tree(tmp_path)
+    baseline = tmp_path / "baseline.json"
+
+    def run(*argv):
+        with pytest.raises(SystemExit) as e:
+            dedlint_main(list(argv))
+        return e.value.code
+
+    # no baseline: the violation is new -> exit 1
+    assert run("--root", str(tmp_path), "--gate", str(baseline)) == 1
+    # baselined -> exit 0, and the report names it as covered
+    findings = dedlint.run_checks(str(tmp_path))
+    baseline.write_text(json.dumps({findings[0].key: 1}))
+    assert run("--root", str(tmp_path), "--gate", str(baseline)) == 0
+    # malformed baseline: warn, never wedge (bench_gate convention) — and
+    # say SKIPPED, not the failure banner the exit code would contradict
+    baseline.write_text("{not json")
+    capsys.readouterr()  # drain the earlier (legitimate) failure output
+    assert run("--root", str(tmp_path), "--gate", str(baseline)) == 0
+    out = capsys.readouterr().out
+    assert "malformed baseline" in out
+    assert "gate SKIPPED" in out and "GATE FAILED" not in out
+    # --json mode must carry the skip explicitly: a machine consumer that
+    # inferred pass/fail from "new" would contradict the exit code
+    assert run("--root", str(tmp_path), "--gate", str(baseline),
+               "--json") == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["baseline_malformed"] and payload["gate_skipped"]
+    assert payload["new"] >= 1  # the data the flag exists to disarm
+    # unusable input -> exit 2
+    assert run("--root", str(tmp_path / "nope"), "--gate") == 2
+
+
+def test_cli_gate_catches_orphan_task_and_unknown_consumed_key(tmp_path):
+    pkg = tmp_path / "dedloc_tpu" / "dht"
+    pkg.mkdir(parents=True)
+    (pkg / "x.py").write_text(
+        "import asyncio\n\n\nasync def go(h):\n"
+        "    asyncio.create_task(h())\n"
+    )
+    tele = tmp_path / "dedloc_tpu" / "telemetry"
+    tele.mkdir(parents=True)
+    (tele / "health.py").write_text(
+        'def fold(t):\n    return t.get("never.emitted_anywhere")\n'
+    )
+    # the synthetic telemetry/ dir also arms the catalog-staleness check;
+    # give it a fresh catalog so only the two planted violations remain
+    findings = dedlint.run_checks(str(tmp_path))
+    rules = rules_of(findings)
+    assert "async-orphan-task" in rules
+    assert "schema-consumed-unknown" in rules
+    with pytest.raises(SystemExit) as e:
+        dedlint_main(["--root", str(tmp_path), "--gate",
+                      str(tmp_path / "baseline.json")])
+    assert e.value.code == 1
+
+
+# ------------------------------------------------------- the tier-1 gate
+
+
+@pytest.fixture(scope="module")
+def repo_findings():
+    return dedlint.run_checks(str(REPO))
+
+
+def test_repo_tree_is_dedlint_clean(repo_findings):
+    """THE gate: zero non-baselined findings over the shipped tree."""
+    baseline, warnings = load_baseline(
+        str(REPO / dedlint.DEFAULT_BASELINE_REL)
+    )
+    assert "__malformed__" not in warnings, warnings
+    new, stale = gate_findings(repo_findings, baseline)
+    assert not new, "new dedlint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert not stale, "stale baseline entries (delete them):\n" + "\n".join(
+        stale
+    )
+
+
+def test_repo_telemetry_catalog_is_fresh(repo_findings):
+    """events.py must match the emit sites (regeneration is a no-op)."""
+    assert not [
+        f for f in repo_findings if f.rule == "schema-catalog-stale"
+    ], "run: python -m tools.dedlint --write-events"
+    from dedloc_tpu.telemetry import events
+
+    assert events.known_key("mm.rounds_attempted")
+    assert events.known_key("avg.round.mean")
+    assert events.known_key("step.phase.fwd_bwd.mean")
+    assert not events.known_key("never.emitted_anywhere")
+
+
+def test_cli_end_to_end_gate_passes_on_shipped_tree():
+    """Acceptance: ``python -m tools.dedlint --gate`` exits 0 as shipped."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.dedlint", "--gate"],
+        cwd=str(REPO), capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "gate passed" in proc.stdout

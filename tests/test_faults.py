@@ -27,7 +27,10 @@ def test_fault_schedule_is_seeded_and_bounded():
         s2.rng.random() for _ in range(5)
     ], "same seed must replay the same randomness"
     s = FaultSchedule(seed=0)
-    s.inject("p", "drop", times=2, match=lambda ctx: ctx["x"] > 0)
+    # dedlint: disable=schema-fault-point-unknown — mechanism unit test,
+    # the point name is arbitrary by design
+    s.inject("p", "drop", times=2,  # dedlint: disable=schema-fault-point-unknown
+             match=lambda ctx: ctx["x"] > 0)
     assert s.fire("p", x=0) is None  # match filter
     assert s.fire("p", x=1) is not None
     assert s.fire("p", x=1) is not None

@@ -1,0 +1,107 @@
+"""dedlint: project-native static analysis for dedloc_tpu (ISSUE 14).
+
+Four checker families guard the invariants every review-hardening pass in
+CHANGES.md kept re-fixing by hand: clock discipline in simulator-reachable
+modules, async task/blocking hygiene, lock discipline on cross-thread
+state, and telemetry-schema drift (emitters vs consumers, fault points,
+config flags). Run as a CLI (``python -m tools.dedlint --gate``) and as a
+tier-1 test (tests/test_dedlint.py). See docs/contributor.md.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import checks_async, checks_clock, checks_locks, checks_schema
+from .core import (
+    ALL_RULES,
+    Finding,
+    ScannedFile,
+    baseline_payload,
+    gate_findings,
+    load_baseline,
+    parse_error_findings,
+    render_report,
+    scan_tree,
+)
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "DEFAULT_BASELINE_REL",
+    "repo_root",
+    "scan",
+    "run_checks",
+    "baseline_payload",
+    "gate_findings",
+    "load_baseline",
+    "render_report",
+]
+
+DEFAULT_BASELINE_REL = "tools/dedlint/baseline.json"
+
+
+def repo_root() -> str:
+    return os.path.abspath(
+        os.path.join(os.path.dirname(__file__), os.pardir, os.pardir)
+    )
+
+
+def scan(root: str) -> List[ScannedFile]:
+    """One shared parse of everything any checker reads: the production
+    tree, the tools, and the tests (tests are scanned for schema
+    cross-checks — fault injections and flag references — not for the
+    code-hygiene rules)."""
+    return scan_tree(
+        root, rel_dirs=("dedloc_tpu", "tools", "tests"),
+        rel_files=("bench.py",),
+    )
+
+
+def _hygiene_scope(sf: ScannedFile) -> bool:
+    return sf.rel.startswith(("dedloc_tpu/", "tools/")) or sf.rel == "bench.py"
+
+
+def run_checks(
+    root: str,
+    rules: Optional[Sequence[str]] = None,
+    files: Optional[List[ScannedFile]] = None,
+) -> List[Finding]:
+    if files is None:
+        files = scan(root)
+    hygiene = [sf for sf in files if _hygiene_scope(sf)]
+    findings: List[Finding] = []
+    findings.extend(
+        f for f in parse_error_findings(hygiene) if f.rule in _want(rules)
+    )
+    if _wants_any(rules, "clock-"):
+        findings.extend(checks_clock.check(hygiene))
+    if _wants_any(rules, "async-"):
+        findings.extend(checks_async.check(hygiene))
+    if _wants_any(rules, "lock-"):
+        findings.extend(checks_locks.check(hygiene))
+    if _wants_any(rules, "schema-"):
+        findings.extend(checks_schema.check(files, root))
+    want = _want(rules)
+    findings = [f for f in findings if f.rule in want]
+    # dedupe (a site can be reached by more than one walk) + stable order
+    seen = set()
+    unique: List[Finding] = []
+    for f in sorted(
+        findings, key=lambda f: (f.path, f.line, f.col, f.rule, f.detail)
+    ):
+        # col included: same-line duplicate violations are distinct; only
+        # true double-walk hits of the SAME node collapse
+        key = (f.rule, f.path, f.line, f.col, f.detail)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique
+
+
+def _want(rules: Optional[Sequence[str]]) -> frozenset:
+    return frozenset(rules) if rules else frozenset(ALL_RULES)
+
+
+def _wants_any(rules: Optional[Sequence[str]], prefix: str) -> bool:
+    return rules is None or any(r.startswith(prefix) for r in rules)

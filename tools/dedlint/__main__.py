@@ -1,0 +1,189 @@
+"""dedlint CLI.
+
+Usage::
+
+    # full report (new + baselined findings), never fails the build
+    python -m tools.dedlint
+
+    # CI gate: exit 1 on any finding NOT covered by the checked-in
+    # baseline (tools/dedlint/baseline.json); stale entries are reported
+    # so fixed violations get deleted from it
+    python -m tools.dedlint --gate
+    python -m tools.dedlint --gate path/to/other_baseline.json
+
+    # regenerate the telemetry name catalog from the emit sites
+    python -m tools.dedlint --write-events
+
+    # re-record the baseline (grandfather everything currently found —
+    # bootstrap / deliberate-debt tool, not a way to silence the gate)
+    python -m tools.dedlint --write-baseline
+
+Exit codes follow bench_gate/t1_budget conventions: 0 = clean (or plain
+report mode), 1 = gate failed on new findings, 2 = unusable input (bad
+--root). A malformed baseline warns and SKIPS the gate (exit 0) rather
+than wedging CI on a bad merge.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import (
+    ALL_RULES,
+    DEFAULT_BASELINE_REL,
+    baseline_payload,
+    gate_findings,
+    load_baseline,
+    render_report,
+    repo_root,
+    run_checks,
+    scan,
+)
+from .checks_schema import EVENTS_REL, collect_emits, generate_events_source
+from .core import fail
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="dedlint", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root to scan (default: this checkout)",
+    )
+    parser.add_argument(
+        "--gate", nargs="?", const="", metavar="BASELINE_JSON",
+        default=None,
+        help="exit 1 on findings not covered by the baseline "
+             "(default baseline: tools/dedlint/baseline.json)",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline file for report annotation (report mode)",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule filter (see --list-rules)",
+    )
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument(
+        "--write-events", action="store_true",
+        help=f"regenerate {EVENTS_REL} from the emit sites and exit",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="re-record the baseline from everything currently found",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print("\n".join(ALL_RULES))
+        return
+
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    if not os.path.isdir(root):
+        fail(f"--root {root} is not a directory")
+
+    rules = None
+    if args.rules:
+        rules = [r for r in args.rules.split(",") if r]
+        unknown = sorted(set(rules) - set(ALL_RULES))
+        if unknown:
+            fail(f"unknown rule(s): {', '.join(unknown)} (see --list-rules)")
+
+    files = scan(root)
+
+    if args.write_events:
+        catalog, _dyn = collect_emits(files)
+        path = os.path.join(root, EVENTS_REL)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(generate_events_source(catalog) + "\n")
+        print(f"wrote {len(catalog.names)} names, "
+              f"{len(catalog.prefixes)} prefixes to {path}")
+        return
+
+    findings = run_checks(root, rules=rules, files=files)
+
+    if args.baseline:
+        baseline_path = args.baseline
+    elif args.gate:  # ``--gate other.json`` names its own baseline
+        baseline_path = args.gate
+    else:
+        baseline_path = os.path.join(root, DEFAULT_BASELINE_REL)
+
+    if args.write_baseline:
+        payload = baseline_payload(findings)
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"recorded {sum(payload.values())} finding(s) "
+              f"({len(payload)} key(s)) to {baseline_path}")
+        return
+
+    baseline, warnings = load_baseline(baseline_path)
+    malformed = "__malformed__" in warnings
+    if malformed and args.gate is not None and not args.json:
+        # warn-not-wedge, stated as what it IS: the gate was skipped, not
+        # failed — printing the normal failure banner here would contradict
+        # the exit code in CI logs
+        for w in warnings:
+            if w != "__malformed__":
+                print(w)
+        print(
+            "dedlint gate SKIPPED (malformed baseline, exit 0): "
+            f"{len(findings)} finding(s) went unchecked — repair "
+            f"{baseline_path} promptly"
+        )
+        sys.exit(0)
+    new, stale = gate_findings(findings, baseline)
+
+    if args.json:
+        new_set = set(new)
+        print(json.dumps(
+            {
+                "root": root,
+                "findings": [
+                    {
+                        "rule": f.rule,
+                        "path": f.path,
+                        "line": f.line,
+                        "scope": f.scope,
+                        "detail": f.detail,
+                        "message": f.message,
+                        "key": f.key,
+                        "baselined": f not in new_set,
+                    }
+                    for f in findings
+                ],
+                "new": len(new),
+                "stale_baseline": stale,
+                "baseline_malformed": malformed,
+                # a malformed baseline SKIPS the gate (exit 0) — machine
+                # consumers must read this flag, not infer pass/fail from
+                # "new", or they re-wedge the build warn-not-wedge avoids
+                "gate_skipped": malformed and args.gate is not None,
+            },
+            indent=1,
+        ))
+    else:
+        print(render_report(
+            findings, baseline, stale,
+            [w for w in warnings if w != "__malformed__"],
+            gate=args.gate is not None,
+        ))
+
+    if args.gate is not None:
+        if malformed:
+            # warn-not-wedge: a corrupted baseline must not block CI; the
+            # stale/warning text above says how to repair it
+            sys.exit(0)
+        sys.exit(1 if new else 0)
+
+
+if __name__ == "__main__":
+    main()

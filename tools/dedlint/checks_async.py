@@ -1,0 +1,142 @@
+"""Async hygiene: no orphaned tasks, no blocking calls inside coroutines.
+
+- ``async-orphan-task``: ``asyncio.create_task`` / ``asyncio.ensure_future``
+  (or ``loop.create_task``) used as a bare expression statement. Nothing
+  retains the handle, so (a) the event loop only holds a weak reference and
+  the task can be garbage-collected mid-flight, and (b) an exception inside
+  it is silently swallowed until interpreter shutdown prints "Task exception
+  was never retrieved" — the PR 7 catalog-announce flake class. Retain the
+  handle (``utils/aio.keep_task`` logs the exception and keeps a strong
+  reference) or await it.
+- ``async-blocking-call``: synchronous sleeps / subprocess / socket / file
+  I/O called from inside an ``async def``. One blocked coroutine freezes the
+  whole event loop — every RPC this peer is serving stalls behind it; under
+  the simulator it stalls virtual time entirely.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from .core import Finding, ScannedFile, call_name
+
+_SPAWNERS = {"create_task", "ensure_future"}
+
+# dotted-origin names that block the loop; methods are matched on the
+# resolved dotted form so ``loop.sock_connect`` (async) never trips it
+_BLOCKING = {
+    "time.sleep",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "socket.gethostbyname",
+    "urllib.request.urlopen",
+    "requests.get",
+    "requests.post",
+    "requests.request",
+    "os.system",
+    "open",
+}
+
+
+def _spawner_name(node: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    name = call_name(node, aliases)
+    if name is None:
+        # dynamic receiver (e.g. ``self._loop.create_task``): fall back to
+        # the attribute name alone
+        if isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+        else:
+            return None
+    tail = name.rsplit(".", 1)[-1]
+    return name if tail in _SPAWNERS else None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, sf: ScannedFile):
+        self.sf = sf
+        self.aliases = sf.aliases
+        self.scopes = sf.scopes
+        self.findings: List[Finding] = []
+        self._func_stack: List[ast.AST] = []
+
+    # ------------------------------------------------------------- helpers
+
+    def _in_coroutine(self) -> bool:
+        return bool(self._func_stack) and isinstance(
+            self._func_stack[-1], ast.AsyncFunctionDef
+        )
+
+    def _add(self, rule: str, node: ast.AST, detail: str, msg: str) -> None:
+        if self.sf.suppressed(rule, node.lineno):
+            return
+        self.findings.append(
+            Finding(
+                rule=rule,
+                path=self.sf.rel,
+                line=node.lineno,
+                scope=self.scopes.get(node, ""),
+                detail=detail,
+                col=getattr(node, "col_offset", 0),
+                message=msg,
+            )
+        )
+
+    # -------------------------------------------------------------- visits
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._func_stack.append(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._func_stack.append(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        value = node.value
+        if isinstance(value, ast.Await):  # ``await create_task`` is fine
+            self.generic_visit(node)
+            return
+        if isinstance(value, ast.Call):
+            spawner = _spawner_name(value, self.aliases)
+            if spawner is not None:
+                self._add(
+                    "async-orphan-task",
+                    value,
+                    spawner.rsplit(".", 1)[-1],
+                    f"fire-and-forget {spawner}(): nothing retains the "
+                    "task, so it can be GC'd mid-flight and its exception "
+                    "vanishes — retain the handle (utils/aio.keep_task) "
+                    "or await it",
+                )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._in_coroutine():
+            name = call_name(node, self.aliases)
+            if name in _BLOCKING:
+                self._add(
+                    "async-blocking-call",
+                    node,
+                    name,
+                    f"blocking {name}() inside a coroutine stalls the "
+                    "whole event loop — use the async equivalent "
+                    "(asyncio.sleep / to_thread / run_in_executor)",
+                )
+        self.generic_visit(node)
+
+
+def check(files: List[ScannedFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        v = _Visitor(sf)
+        v.visit(sf.tree)
+        findings.extend(v.findings)
+    return findings

@@ -1,0 +1,158 @@
+"""Clock discipline: no raw wall clocks in simulator-reachable modules.
+
+Everything under the transport seam can run on the discrete-event engine
+(docs/simulator.md), where scenario time is VIRTUAL: ``get_dht_time()`` /
+``timeutils.monotonic()`` jump with the engine's clock while
+``time.monotonic()`` keeps counting the real seconds the host spends
+executing Python. A raw wall-clock read in a sim-reachable deadline
+therefore (a) leaks host execution time into a supposedly deterministic
+timeline — two same-seed runs diverge wherever a comparison is close — and
+(b) under ``FakeClock`` scenarios never sees injected time advance, turning
+instant virtual waits back into real soaks (the exact bug class PR 7/11
+fixed by hand in matchmaking and the RPC connect timer).
+
+Rules:
+
+- ``clock-wall``: ``time.time()`` / ``datetime.now()`` family — wall time
+  additionally jumps on NTT/NTP steps, so it is wrong for durations even in
+  production. Use ``get_dht_time()`` (shared scenario time).
+- ``clock-monotonic``: ``time.monotonic()`` / ``time.perf_counter()``
+  family — fine in production, blind to FakeClock/sim time. Use
+  ``timeutils.monotonic()`` (identical when no fake source is installed)
+  or the registry's ``monotonic_clock``.
+- ``clock-bare-sleep``: ``await asyncio.sleep(..)`` polling a raw
+  wall-clock deadline (``while time.monotonic() < deadline: await
+  asyncio.sleep(..)``) — the loop burns real time against a wall deadline
+  the virtual clock cannot reach.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from .core import Finding, ScannedFile, call_name, dotted_name
+
+# module dirs reachable from the simulator seam (ISSUE 14 / docs/simulator.md)
+SIM_REACHABLE = (
+    "dedloc_tpu/dht/",
+    "dedloc_tpu/averaging/",
+    "dedloc_tpu/simulator/",
+    "dedloc_tpu/telemetry/",
+    "dedloc_tpu/checkpointing/",
+)
+
+_WALL = {
+    "time.time",
+    "time.time_ns",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.today",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "datetime.date.today",
+}
+_MONOTONIC = {
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+}
+
+
+def _is_raw_clock(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and (call_name(node, aliases) or "") in (_WALL | _MONOTONIC)
+    )
+
+
+def _walk_same_function(node: ast.AST):
+    """ast.walk, but stop at nested function/lambda boundaries: a callback
+    DEFINED inside the loop body runs later on its own schedule — its
+    sleeps never poll this loop's deadline."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.append(child)
+
+
+def check(files: List[ScannedFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None or not sf.rel.startswith(SIM_REACHABLE):
+            continue
+        aliases = sf.aliases
+        scopes = sf.scopes
+
+        for node in ast.walk(sf.tree):
+            # calls AND bare references: ``default_factory=time.monotonic``
+            # smuggles the raw clock in without a Call node (the
+            # routing.py last_seen case). Call sites are flagged via their
+            # func expression; the runner dedupes the double hit.
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                name = dotted_name(node, aliases)
+                rule = None
+                if name in _WALL:
+                    rule, hint = "clock-wall", "get_dht_time()"
+                elif name in _MONOTONIC:
+                    rule, hint = "clock-monotonic", "timeutils.monotonic()"
+                if rule and not sf.suppressed(rule, node.lineno):
+                    findings.append(
+                        Finding(
+                            rule=rule,
+                            path=sf.rel,
+                            line=node.lineno,
+                            scope=scopes.get(node, ""),
+                            detail=name,
+                            col=node.col_offset,
+                            message=(
+                                f"raw {name}() in a simulator-reachable "
+                                f"module — use {hint} so FakeClock/sim "
+                                "time stays authoritative"
+                            ),
+                        )
+                    )
+            elif isinstance(node, ast.While) and any(
+                _is_raw_clock(test_node, aliases)
+                for test_node in ast.walk(node.test)
+            ):
+                # a raw-clock poll loop: every awaited asyncio.sleep in the
+                # body burns real seconds against a deadline virtual time
+                # cannot reach
+                for body_node in _walk_same_function(node):
+                    if (
+                        isinstance(body_node, ast.Await)
+                        and isinstance(body_node.value, ast.Call)
+                        and (
+                            call_name(body_node.value, aliases)
+                            or ""
+                        ).endswith("asyncio.sleep")
+                        and not sf.suppressed(
+                            "clock-bare-sleep", body_node.lineno
+                        )
+                    ):
+                        findings.append(
+                            Finding(
+                                rule="clock-bare-sleep",
+                                path=sf.rel,
+                                line=body_node.lineno,
+                                scope=scopes.get(body_node, ""),
+                                detail="asyncio.sleep",
+                                col=body_node.col_offset,
+                                message=(
+                                    "asyncio.sleep polling a raw "
+                                    "wall-clock deadline — derive the "
+                                    "deadline from timeutils.monotonic() "
+                                    "(or wait on an event) so the "
+                                    "simulator can expire it"
+                                ),
+                            )
+                        )
+    return findings

@@ -1,0 +1,213 @@
+"""Lock discipline: attributes written under a lock are locked attributes.
+
+If ANY site in a class assigns ``self.x`` inside ``with self._lock:``, then
+``self.x`` is cross-thread shared state and EVERY other mutation of it in
+that class must hold a lock too — ``+=`` is a non-atomic load/add/store in
+CPython, and the trainer thread and DHT event-loop threads hit telemetry /
+optimizer state concurrently (the PR 2 undercount bug class). ``__init__``
+(and anything it calls into, construction-time) is exempt: the object is
+not yet published to other threads.
+
+Caller-holds-the-lock helpers are inferred intra-class: a PRIVATE method
+(leading underscore) whose every ``self._helper(...)`` call site inside the
+class is under a lock — directly or transitively through other inferred
+methods — counts as locked, so the ``step() -> _global_step() ->
+_apply_and_advance()`` chain needs no annotations. The inference is
+deliberately conservative where it cannot be sound:
+
+- PUBLIC methods never inherit it (external callers are invisible to the
+  checker),
+- code inside a nested ``def``/closure never inherits it (a done-callback
+  defined under the lock runs later, on whatever thread resolves it), and
+- a private method REFERENCED without being called (``call_soon(
+  self._helper)``) never inherits it either — the reference escapes to
+  deferred execution the call-site analysis cannot see.
+
+Sites the inference cannot cover but a human can prove (single-threaded
+construction phase, public join-time entry points) document the contract
+with ``# dedlint: disable=lock-unguarded-mutation — reason`` on the
+assignment line, which is exactly the documentation a reviewer needs
+anyway.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from .core import Finding, ScannedFile
+
+
+def _lock_attr_names(expr: ast.AST) -> bool:
+    """True when a with-item context expression is (or wraps) a ``self.X``
+    where X smells like a lock (``_lock``, ``log_lock``, ``cv``...)."""
+    for node in ast.walk(expr):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and "lock" in node.attr.lower()
+        ):
+            return True
+    return False
+
+
+def _self_attr_target(target: ast.AST) -> Optional[str]:
+    if (
+        isinstance(target, ast.Attribute)
+        and isinstance(target.value, ast.Name)
+        and target.value.id == "self"
+    ):
+        return target.attr
+    return None
+
+
+def _mutations(node: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """(attr, node) for every ``self.attr = / += ...`` in ``node``
+    (non-recursive into nested classes — handled by the caller's walk)."""
+    out: List[Tuple[str, ast.AST]] = []
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            attr = _self_attr_target(t)
+            if attr is not None:
+                out.append((attr, node))
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for elt in t.elts:
+                    attr = _self_attr_target(elt)
+                    if attr is not None:
+                        out.append((attr, node))
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        attr = _self_attr_target(node.target)
+        if attr is not None and (
+            not isinstance(node, ast.AnnAssign) or node.value is not None
+        ):
+            out.append((attr, node))
+    return out
+
+
+class _ClassAudit:
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        # (attr, node, under_lock, in_init, func_chain)
+        self.sites: List[Tuple[str, ast.AST, bool, bool, tuple]] = []
+        # (callee method name, call under_lock, enclosing func_chain)
+        self.self_calls: List[Tuple[str, bool, tuple]] = []
+        # private methods REFERENCED without being called (passed as a
+        # callback: call_soon(self._h), add_done_callback(self._h)) — they
+        # run later on whatever thread fires them, so the caller-holds-the-
+        # lock inference must never cover them
+        self.escaped: Set[str] = set()
+        self._call_funcs = {
+            id(n.func) for n in ast.walk(cls) if isinstance(n, ast.Call)
+        }
+        self._walk(cls, under_lock=False, func_chain=())
+
+    def _walk(self, node: ast.AST, under_lock: bool, func_chain: tuple) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef) and child is not self.cls:
+                continue  # nested classes audit separately
+            child_lock = under_lock
+            child_chain = func_chain
+            if isinstance(child, (ast.With, ast.AsyncWith)) and any(
+                _lock_attr_names(item.context_expr) for item in child.items
+            ):
+                child_lock = True
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_chain = func_chain + (child.name,)
+                child_lock = False  # a lock is not held across a def
+            if (
+                isinstance(child, ast.Call)
+                and isinstance(child.func, ast.Attribute)
+                and isinstance(child.func.value, ast.Name)
+                and child.func.value.id == "self"
+            ):
+                self.self_calls.append(
+                    (child.func.attr, child_lock, child_chain)
+                )
+            elif (
+                isinstance(child, ast.Attribute)
+                and isinstance(child.value, ast.Name)
+                and child.value.id == "self"
+                and child.attr.startswith("_")
+                and id(child) not in self._call_funcs
+            ):
+                self.escaped.add(child.attr)
+            for attr, site in _mutations(child):
+                in_init = "__init__" in child_chain or "__new__" in child_chain
+                self.sites.append(
+                    (attr, site, child_lock, in_init, child_chain)
+                )
+            self._walk(child, child_lock, child_chain)
+
+    def locked_methods(self) -> Set[str]:
+        """PRIVATE methods provably entered only with the lock held: every
+        intra-class call site is under a lock-with, or inside another
+        method already in the set (fixpoint)."""
+        callees = {
+            name
+            for name, _lock, _chain in self.self_calls
+            if name.startswith("_") and not name.startswith("__")
+        } - self.escaped
+        locked: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for name in callees - locked:
+                sites = [
+                    (under_lock, chain)
+                    for callee, under_lock, chain in self.self_calls
+                    if callee == name
+                ]
+                if sites and all(
+                    # a call inside a nested closure of a locked method
+                    # does NOT count: the closure may run later, unlocked
+                    under_lock or (len(chain) == 1 and chain[0] in locked)
+                    for under_lock, chain in sites
+                ):
+                    locked.add(name)
+                    changed = True
+        return locked
+
+
+def check(files: List[ScannedFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        scopes = sf.scopes
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            audit = _ClassAudit(node)
+            locked: Set[str] = {
+                attr
+                for attr, _site, under_lock, _init, _chain in audit.sites
+                if under_lock
+            }
+            if not locked:
+                continue
+            locked_methods = audit.locked_methods()
+            for attr, site, under_lock, in_init, chain in audit.sites:
+                if attr not in locked or under_lock or in_init:
+                    continue
+                if len(chain) == 1 and chain[0] in locked_methods:
+                    continue  # caller provably holds the lock (see above)
+                if sf.suppressed("lock-unguarded-mutation", site.lineno):
+                    continue
+                findings.append(
+                    Finding(
+                        rule="lock-unguarded-mutation",
+                        path=sf.rel,
+                        line=site.lineno,
+                        scope=scopes.get(site, ""),
+                        detail=f"{node.name}.{attr}",
+                        col=site.col_offset,
+                        message=(
+                            f"self.{attr} is assigned under a lock "
+                            f"elsewhere in {node.name} but mutated "
+                            "lock-free here — take the lock (or document "
+                            "the caller-holds-it contract with a disable "
+                            "pragma)"
+                        ),
+                    )
+                )
+    return findings

@@ -1,0 +1,620 @@
+"""Telemetry-schema drift: emitters, consumers, fault points, config flags.
+
+Stringly-typed names are this repo's only schema language: telemetry
+counter/event names cross from emit sites to the health fold, the watchdog,
+the twin fitter and runlog_summary as bare dict keys; fault-point names
+cross from production ``faults.fire`` sites to test ``inject`` calls; and
+``--x.y`` flags cross from ``core/config.py`` dataclasses into docs and
+tests. Each pair can drift silently (PR 12 had to redefine a rate because
+producer and consumer disagreed). This checker makes every one of those
+contracts a build-time fact:
+
+- ``schema-catalog-stale``: ``dedloc_tpu/telemetry/events.py`` is GENERATED
+  from the emit sites (``--write-events``); the checked-in file must match.
+- ``schema-dynamic-name``: an emit site whose name the AST cannot resolve
+  and that carries no ``# dedlint: emits=...`` pragma — undeclared names
+  would punch silent holes in the catalog.
+- ``schema-consumed-unknown``: a telemetry-shaped key literal in a consumer
+  file that no emit site (or declared prefix) produces.
+- ``schema-fault-point-unknown``: a test injects a fault point no
+  production site fires — the fault silently never triggers.
+- ``schema-config-flag-unknown``: a ``--x.y`` flag referenced in docs or
+  tests that no dataclass tree defines.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ScannedFile, call_name, dotted_name
+
+EVENTS_REL = "dedloc_tpu/telemetry/events.py"
+
+# files whose string keys are CONSUMED telemetry names (ISSUE 14)
+CONSUMER_FILES = (
+    "dedloc_tpu/telemetry/health.py",
+    "dedloc_tpu/telemetry/watch.py",
+    "dedloc_tpu/twin/fit.py",
+    "tools/runlog_summary.py",
+    "tools/swarm_watch.py",
+)
+
+_EMIT_METHODS = {
+    "counter": "counter",
+    "gauge": "gauge",
+    "histogram": "histogram",
+    "event": "event",
+    "span": "span",
+}
+
+_KEY_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_*]+)+$")
+_FLAG_RE = re.compile(r"--([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)")
+_SNAPSHOT_SUFFIXES = (".count", ".mean", ".max", ".min")
+
+
+def _in_emit_scope(rel: str) -> bool:
+    if rel in ("dedloc_tpu/telemetry/registry.py", EVENTS_REL):
+        # registry.py is the mechanism (generic name-typed methods);
+        # events.py is the generated catalog itself
+        return False
+    return rel.startswith("dedloc_tpu/") or rel == "bench.py"
+
+
+# ------------------------------------------------------------- emit sites
+
+
+class Catalog:
+    def __init__(self) -> None:
+        # name -> set of kinds ("counter"/"gauge"/"histogram"/"event"/"span")
+        self.names: Dict[str, Set[str]] = {}
+        self.prefixes: Set[str] = set()
+
+    def add(self, name: str, kind: str) -> None:
+        self.names.setdefault(name, set()).add(kind)
+
+    def histogram_names(self) -> Set[str]:
+        return {
+            n
+            for n, kinds in self.names.items()
+            if kinds & {"histogram", "span"}
+        }
+
+    def known_key(self, key: str) -> bool:
+        if key in self.names:
+            return True
+        if any(key.startswith(p) for p in self.prefixes):
+            return True
+        for suffix in _SNAPSHOT_SUFFIXES:
+            if key.endswith(suffix):
+                base = key[: -len(suffix)]
+                if base in self.histogram_names() or any(
+                    base.startswith(p) for p in self.prefixes
+                ):
+                    return True
+        return False
+
+    def known_prefix(self, prefix: str) -> bool:
+        """A ``key.startswith("x.")`` consumption: valid when some emitted
+        name or declared wildcard lives under it."""
+        return any(n.startswith(prefix) for n in self.names) or any(
+            p.startswith(prefix) or prefix.startswith(p)
+            for p in self.prefixes
+        )
+
+
+def _fstring_prefix(node: ast.JoinedStr) -> Optional[str]:
+    prefix = ""
+    for part in node.values:
+        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+            prefix += part.value
+        else:
+            break
+    return prefix if prefix else None
+
+
+def collect_emits(
+    files: Sequence[ScannedFile],
+) -> Tuple[Catalog, List[Finding]]:
+    catalog = Catalog()
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None or not _in_emit_scope(sf.rel):
+            continue
+        # file-level declarations: every ``# dedlint: emits=`` pragma adds
+        # names/prefixes even when the producing code is not a registry
+        # call (links.py builds flat ``link.<dst>.<field>`` snapshot keys
+        # by hand)
+        for names in sf.emits.values():
+            for declared in names:
+                # optional kind prefix: ``emits=span:state.serve`` puts the
+                # name in the right derived set (spans/histograms flatten
+                # to .count/.mean/.max snapshot keys; plain events do not)
+                kind = "event"
+                if ":" in declared:
+                    kind, declared = declared.split(":", 1)
+                    if kind not in _EMIT_METHODS:
+                        kind = "event"
+                if declared.endswith("*"):
+                    catalog.prefixes.add(declared.rstrip("*"))
+                else:
+                    catalog.add(declared, kind)
+        aliases = sf.aliases
+        scopes = sf.scopes
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            kind = _EMIT_METHODS.get(node.func.attr)
+            if kind is None:
+                # module-level helper: registry.inc("x") is a counter
+                name = call_name(node, aliases) or ""
+                if name.endswith("registry.inc"):
+                    kind = "counter"
+                else:
+                    continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant):
+                if isinstance(arg.value, str):
+                    catalog.add(arg.value, kind)
+                continue  # e.g. Counter.inc(5) — not a name-typed call
+            if isinstance(arg, ast.JoinedStr):
+                prefix = _fstring_prefix(arg)
+                if prefix:
+                    catalog.prefixes.add(prefix)
+                    continue
+            # dynamic name: must be declared on the line (emits pragma) or
+            # explicitly suppressed
+            if sf.emits_pragma(node.lineno) or sf.suppressed(
+                "schema-dynamic-name", node.lineno
+            ):
+                continue
+            findings.append(
+                Finding(
+                    rule="schema-dynamic-name",
+                    path=sf.rel,
+                    line=node.lineno,
+                    scope=scopes.get(node, ""),
+                    detail=f".{node.func.attr}(<dynamic>)",
+                    col=node.col_offset,
+                    message=(
+                        f"dynamic telemetry name in .{node.func.attr}() — "
+                        "declare what it produces with "
+                        "'# dedlint: emits=some.name' or "
+                        "'# dedlint: emits=some.prefix.*' so the catalog "
+                        "stays complete"
+                    ),
+                )
+            )
+    return catalog, findings
+
+
+# --------------------------------------------------------- generated file
+
+
+def _const_name(key: str) -> str:
+    return key.upper().replace(".", "_")
+
+
+def generate_events_source(catalog: Catalog) -> str:
+    kinds_order = ("counter", "gauge", "histogram", "span", "event")
+    by_kind: Dict[str, List[str]] = {k: [] for k in kinds_order}
+    for name, kinds in sorted(catalog.names.items()):
+        for k in kinds:
+            by_kind[k].append(name)
+    lines: List[str] = [
+        '"""Telemetry name catalog — GENERATED, do not edit by hand.',
+        "",
+        "Regenerate after adding/renaming any emitted counter/gauge/",
+        "histogram/span/event name::",
+        "",
+        "    python -m tools.dedlint --write-events",
+        "",
+        "The dedlint schema checker (tools/dedlint) extracts every name",
+        "emitted through telemetry/registry.py call sites (plus declared",
+        "dynamic prefixes) and fails tier-1 when this file is stale or when",
+        "a consumer reads a key nothing emits (docs/contributor.md).",
+        '"""',
+        "",
+    ]
+    emitted_consts: Dict[str, str] = {}
+    for name in sorted(catalog.names):
+        const = _const_name(name)
+        if const in emitted_consts:
+            # two names flattening to one identifier: keep the first, the
+            # frozensets below still carry both
+            lines.append(f"# name collision, no constant: {name!r}")
+            continue
+        emitted_consts[const] = name
+        lines.append(f'{const} = "{name}"')
+    lines.append("")
+
+    def freeze(title: str, names: Iterable[str]) -> None:
+        names = sorted(set(names))
+        lines.append(f"{title} = frozenset({{")
+        for n in names:
+            lines.append(f'    "{n}",')
+        lines.append("})")
+
+    freeze("COUNTERS", by_kind["counter"])
+    freeze("GAUGES", by_kind["gauge"])
+    # span exits feed the histogram of the same name AND emit an event of
+    # the same name, so spans appear in both derived sets
+    freeze("HISTOGRAMS", by_kind["histogram"] + by_kind["span"])
+    freeze("EVENTS", by_kind["event"] + by_kind["span"])
+    freeze("SPANS", by_kind["span"])
+    lines.append("EMITTED = COUNTERS | GAUGES | HISTOGRAMS | EVENTS")
+    lines.append("")
+    lines.append("# declared dynamic-name families (emit-site pragmas)")
+    lines.append("EMITTED_PREFIXES = (")
+    for p in sorted(catalog.prefixes):
+        lines.append(f'    "{p}",')
+    lines.append(")")
+    lines.append("")
+    lines.append("# how histograms flatten onto the metrics-bus snapshot")
+    lines.append(
+        "SNAPSHOT_SUFFIXES = (\".count\", \".mean\", \".max\", \".min\")"
+    )
+    lines.append("")
+    lines.append(
+        '''
+
+def known_key(key: str) -> bool:
+    """True when ``key`` is a name some instrumented site emits: exact,
+    under a declared dynamic prefix, or a snapshot-flattened histogram
+    field (``<histogram>.mean`` etc)."""
+    if key in EMITTED:
+        return True
+    if key.startswith(EMITTED_PREFIXES):
+        return True
+    for suffix in SNAPSHOT_SUFFIXES:
+        if key.endswith(suffix):
+            base = key[: -len(suffix)]
+            if base in HISTOGRAMS or base.startswith(EMITTED_PREFIXES):
+                return True
+    return False
+'''.strip()
+    )
+    lines.append("")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------- consumed keys
+
+
+def _docstring_nodes(tree: ast.AST) -> Set[int]:
+    """id()s of Constant nodes that are docstrings."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(
+            node,
+            (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef),
+        ):
+            body = getattr(node, "body", [])
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)
+            ):
+                out.add(id(body[0].value))
+    return out
+
+
+def check_consumers(
+    files: Sequence[ScannedFile], catalog: Catalog
+) -> List[Finding]:
+    findings: List[Finding] = []
+    by_rel = {sf.rel: sf for sf in files}
+    for rel in CONSUMER_FILES:
+        sf = by_rel.get(rel)
+        if sf is None or sf.tree is None:
+            continue
+        docstrings = _docstring_nodes(sf.tree)
+        scopes = sf.scopes
+        # emit-site name args in the same file are emits, not consumption
+        emit_args: Set[int] = set()
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _EMIT_METHODS
+                and node.args
+            ):
+                emit_args.add(id(node.args[0]))
+        # ``key.startswith("some.prefix.")`` consumes a whole family
+        prefix_args: Set[int] = set()
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "startswith"
+            ):
+                for arg in node.args[:1]:
+                    for c in ast.walk(arg):
+                        if isinstance(c, ast.Constant):
+                            prefix_args.add(id(c))
+        parent_joined: Set[int] = {
+            id(v)
+            for node in ast.walk(sf.tree)
+            if isinstance(node, ast.JoinedStr)
+            for v in node.values
+        }
+        for node in ast.walk(sf.tree):
+            if (
+                not isinstance(node, ast.Constant)
+                or not isinstance(node.value, str)
+                or id(node) in docstrings
+                or id(node) in emit_args
+                or id(node) in parent_joined
+            ):
+                continue
+            value = node.value
+            if id(node) in prefix_args:
+                # a trailing dot marks an explicit family ("mm." covers
+                # mm.*); a dotted key-shaped literal WITHOUT one is still a
+                # prefix consumption ("mm.form_group" matches the span and
+                # any sub-key) — both must resolve against the catalog, or
+                # a producer rename silently zeroes the consumer view
+                shaped = value.endswith(".") or (
+                    _KEY_RE.match(value) is not None and "*" not in value
+                )
+                if (
+                    shaped
+                    and not catalog.known_prefix(value)
+                    and not sf.suppressed(
+                        "schema-consumed-unknown", node.lineno
+                    )
+                ):
+                    findings.append(
+                        Finding(
+                            rule="schema-consumed-unknown",
+                            path=sf.rel,
+                            line=node.lineno,
+                            scope=scopes.get(node, ""),
+                            detail=value + "*",
+                            col=node.col_offset,
+                            message=(
+                                f"consumed key prefix {value!r} matches "
+                                "nothing any instrumented site emits"
+                            ),
+                        )
+                    )
+                continue
+            if not _KEY_RE.match(value) or "*" in value:
+                continue
+            if catalog.known_key(value):
+                continue
+            if sf.suppressed("schema-consumed-unknown", node.lineno):
+                continue
+            findings.append(
+                Finding(
+                    rule="schema-consumed-unknown",
+                    path=sf.rel,
+                    line=node.lineno,
+                    scope=scopes.get(node, ""),
+                    detail=value,
+                    col=node.col_offset,
+                    message=(
+                        f"consumed telemetry key {value!r} is emitted "
+                        "nowhere — renamed at the producer, or a typo? "
+                        "(regenerate the catalog with --write-events if "
+                        "you just added the emitter)"
+                    ),
+                )
+            )
+    return findings
+
+
+# ------------------------------------------------------------ fault points
+
+
+def check_fault_points(files: Sequence[ScannedFile]) -> List[Finding]:
+    fired: Set[str] = set()
+    injects: List[Tuple[ScannedFile, ast.Call, str]] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        aliases = sf.aliases
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if not isinstance(node.args[0], ast.Constant) or not isinstance(
+                node.args[0].value, str
+            ):
+                continue
+            name = call_name(node, aliases) or (
+                node.func.attr if isinstance(node.func, ast.Attribute) else ""
+            )
+            point = node.args[0].value
+            if name.endswith(".fire") or name == "fire":
+                if sf.rel.startswith("dedloc_tpu/"):
+                    fired.add(point)
+            elif name.endswith(".inject") or name == "inject":
+                injects.append((sf, node, point))
+    findings: List[Finding] = []
+    for sf, node, point in injects:
+        if point in fired:
+            continue
+        if sf.suppressed("schema-fault-point-unknown", node.lineno):
+            continue
+        findings.append(
+            Finding(
+                rule="schema-fault-point-unknown",
+                path=sf.rel,
+                line=node.lineno,
+                scope=sf.scopes.get(node, ""),
+                detail=point,
+                col=node.col_offset,
+                message=(
+                    f"fault point {point!r} is injected here but no "
+                    "production site fires it — the fault can never "
+                    "trigger (renamed point, or dead test scaffolding)"
+                ),
+            )
+        )
+    return findings
+
+
+# ------------------------------------------------------------ config flags
+
+
+def _dataclass_fields(files: Sequence[ScannedFile]) -> Dict[str, Dict[str, str]]:
+    """class name -> {field: annotation tail} for every @dataclass in
+    dedloc_tpu (bases merged by name)."""
+    raw: Dict[str, Tuple[List[str], Dict[str, str]]] = {}
+    for sf in files:
+        if sf.tree is None or not sf.rel.startswith("dedloc_tpu/"):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            is_dc = False
+            for deco in node.decorator_list:
+                target = deco.func if isinstance(deco, ast.Call) else deco
+                name = dotted_name(target, {}) or ""
+                if name.split(".")[-1] == "dataclass":
+                    is_dc = True
+            if not is_dc:
+                continue
+            fields: Dict[str, str] = {}
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    ann = stmt.annotation
+                    tail = (dotted_name(ann, {}) or "").split(".")[-1]
+                    fields[stmt.target.id] = tail
+            bases = [
+                (dotted_name(b, {}) or "").split(".")[-1] for b in node.bases
+            ]
+            raw[node.name] = (bases, fields)
+    resolved: Dict[str, Dict[str, str]] = {}
+
+    def resolve(name: str, seen: Tuple[str, ...] = ()) -> Dict[str, str]:
+        if name in resolved:
+            return resolved[name]
+        if name not in raw or name in seen:
+            return {}
+        bases, fields = raw[name]
+        merged: Dict[str, str] = {}
+        for base in bases:
+            merged.update(resolve(base, seen + (name,)))
+        merged.update(fields)
+        resolved[name] = merged
+        return merged
+
+    for name in list(raw):
+        resolve(name)
+    return resolved
+
+
+def _valid_flags(classes: Dict[str, Dict[str, str]]) -> Set[str]:
+    paths: Set[str] = set()
+
+    def leaf_paths(cls: str, seen: Tuple[str, ...] = ()) -> List[str]:
+        if cls in seen:
+            return []
+        out: List[str] = []
+        for field, ann in classes.get(cls, {}).items():
+            if ann in classes:
+                out.extend(
+                    f"{field}.{sub}"
+                    for sub in leaf_paths(ann, seen + (cls,))
+                )
+            else:
+                out.append(field)
+        return out
+
+    for cls in classes:
+        for p in leaf_paths(cls):
+            if "." in p:
+                paths.add(p)
+    return paths
+
+
+def check_config_flags(
+    files: Sequence[ScannedFile], root: str
+) -> List[Finding]:
+    valid = _valid_flags(_dataclass_fields(files))
+    findings: List[Finding] = []
+
+    def scan_text(rel: str, lines: Iterable[str]) -> None:
+        for lineno, line in enumerate(lines, start=1):
+            if "dedlint: disable=schema-config-flag-unknown" in line:
+                continue
+            for m in _FLAG_RE.finditer(line):
+                flag = m.group(1)
+                if flag not in valid:
+                    findings.append(
+                        Finding(
+                            rule="schema-config-flag-unknown",
+                            path=rel,
+                            line=lineno,
+                            scope="",
+                            detail=flag,
+                            col=m.start(),
+                            message=(
+                                f"flag --{flag} is referenced here but no "
+                                "config dataclass defines that dotted "
+                                "path — renamed knob or doc rot"
+                            ),
+                        )
+                    )
+
+    docs_dir = os.path.join(root, "docs")
+    if os.path.isdir(docs_dir):
+        for name in sorted(os.listdir(docs_dir)):
+            if not name.endswith(".md"):
+                continue
+            path = os.path.join(docs_dir, name)
+            try:
+                with open(path, encoding="utf-8", errors="replace") as f:
+                    scan_text(f"docs/{name}", f)
+            except OSError:
+                continue
+    for sf in files:
+        if sf.rel.startswith("tests/"):
+            scan_text(sf.rel, sf.lines)
+    return findings
+
+
+# -------------------------------------------------------------- top level
+
+
+def check(files: Sequence[ScannedFile], root: str) -> List[Finding]:
+    catalog, findings = collect_emits(files)
+    findings.extend(check_consumers(files, catalog))
+    findings.extend(check_fault_points(files))
+    findings.extend(check_config_flags(files, root))
+    # catalog staleness: the checked-in generated file must match what the
+    # emit sites say (only when the package is part of the scanned tree —
+    # synthetic fixture roots without a telemetry package skip it)
+    events_path = os.path.join(root, EVENTS_REL)
+    if os.path.isdir(os.path.join(root, "dedloc_tpu", "telemetry")):
+        expected = generate_events_source(catalog)
+        try:
+            with open(events_path, encoding="utf-8") as f:
+                current = f.read()
+        except OSError:
+            current = None
+        if current is None or current.strip() != expected.strip():
+            findings.append(
+                Finding(
+                    rule="schema-catalog-stale",
+                    path=EVENTS_REL,
+                    line=1,
+                    scope="",
+                    detail="generated-catalog",
+                    message=(
+                        "telemetry name catalog is stale vs the emit "
+                        "sites — regenerate with "
+                        "'python -m tools.dedlint --write-events'"
+                    ),
+                )
+            )
+    return findings

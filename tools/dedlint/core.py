@@ -1,0 +1,399 @@
+"""dedlint core: file scanning, findings, suppressions, baseline gate.
+
+The checkers (checks_*.py) are AST visitors over a shared one-parse-per-file
+scan of the tree; this module owns everything rule-independent:
+
+- ``ScannedFile``: path + source + parsed AST + per-line suppression pragmas,
+  parsed ONCE and shared by every checker (the tier-1 test runs the whole
+  suite in-process, so parse cost is paid once per file, not per rule).
+- ``Finding``: one violation. Its ``key`` deliberately excludes line numbers
+  — baselines must survive unrelated edits above a grandfathered site — and
+  instead anchors on (rule, file, enclosing scope, detail). Identical
+  violations in one scope collapse into a count, so ADDING a second raw
+  clock call to an already-grandfathered function is still a new finding.
+- baseline load/compare with t1_budget/bench_gate conventions: a malformed
+  baseline warns loudly and skips (never wedges the gate), stale entries
+  (fixed violations still listed) are reported so the file shrinks with the
+  debt, and only findings NOT covered by the baseline fail ``--gate``.
+
+Suppression pragmas (see docs/contributor.md):
+
+- ``# dedlint: disable=rule[,rule2] — reason`` on the offending line marks
+  the site as permanently intentional (the reason is part of the contract).
+- ``# dedlint: emits=name.or.prefix.*`` on a dynamic telemetry emit site
+  declares what names it produces for the schema catalog.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# rule-id grammar: <checker>-<what>; keep in sync with docs/contributor.md
+ALL_RULES = (
+    "clock-wall",
+    "clock-monotonic",
+    "clock-bare-sleep",
+    "async-orphan-task",
+    "async-blocking-call",
+    "lock-unguarded-mutation",
+    "schema-catalog-stale",
+    "schema-dynamic-name",
+    "schema-consumed-unknown",
+    "schema-fault-point-unknown",
+    "schema-config-flag-unknown",
+    "parse-error",
+)
+
+_PRAGMA_RE = re.compile(r"#\s*dedlint:\s*(disable|emits)=([\w.,*:\-]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    scope: str  # dotted enclosing Class.function qualname ("" = module)
+    detail: str  # short stable descriptor (symbol / attr / key name)
+    message: str
+    # column of the offending node: NOT part of the baseline key (columns
+    # drift as freely as lines) but part of the runner's dedupe identity,
+    # so two identical violations on ONE line stay two findings and the
+    # per-key count ratchet still gates the second one
+    col: int = 0
+
+    @property
+    def key(self) -> str:
+        """Baseline identity: no line numbers (they drift under unrelated
+        edits), but scope+detail so a NEW identical violation elsewhere in
+        the same file still gates."""
+        return f"{self.rule}::{self.path}::{self.scope}::{self.detail}"
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}"
+        scope = f" [{self.scope}]" if self.scope else ""
+        return f"{self.rule}: {where}{scope}: {self.message}"
+
+
+class ScannedFile:
+    """One parsed source file shared by every checker."""
+
+    def __init__(self, abs_path: str, rel_path: str, source: str):
+        self.abs_path = abs_path
+        self.rel = rel_path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(source)
+        except SyntaxError as e:  # surfaced as a finding, never a crash
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        # per-line pragmas: lineno -> {"disable": {rules}, "emits": {names}}
+        self.disabled: Dict[int, set] = {}
+        self.emits: Dict[int, set] = {}
+        for i, line in enumerate(self.lines, start=1):
+            if "dedlint" not in line:
+                continue
+            for kind, value in _PRAGMA_RE.findall(line):
+                bucket = self.disabled if kind == "disable" else self.emits
+                bucket.setdefault(i, set()).update(
+                    v for v in value.split(",") if v
+                )
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        """A ``disable=`` pragma suppresses on its own line; multi-line
+        statements may also carry it on the statement's first line (the
+        flagged node often anchors on a continuation line)."""
+        for ln in (lineno, self._stmt_first_lines.get(lineno, lineno)):
+            rules = self.disabled.get(ln)
+            if rules and (rule in rules or "all" in rules):
+                return True
+        return False
+
+    def emits_pragma(self, lineno: int) -> set:
+        return self.emits.get(lineno, set())
+
+    # import-alias and scope maps are full-AST walks; every checker needs
+    # them, so they are computed once per file, not once per checker
+    @property
+    def aliases(self) -> Dict[str, str]:
+        if not hasattr(self, "_aliases"):
+            self._aliases = (
+                import_aliases(self.tree) if self.tree is not None else {}
+            )
+        return self._aliases
+
+    @property
+    def scopes(self) -> Dict[ast.AST, str]:
+        if not hasattr(self, "_scopes"):
+            self._scopes = (
+                scope_map(self.tree) if self.tree is not None else {}
+            )
+        return self._scopes
+
+    @property
+    def _stmt_first_lines(self) -> Dict[int, int]:
+        """line -> first line of the INNERMOST statement covering it, so a
+        pragma on a multi-line statement's opening line reaches findings
+        anchored on its continuation lines (ast.walk yields outer
+        statements before inner ones, so later writes win)."""
+        if not hasattr(self, "_stmt_lines"):
+            lines: Dict[int, int] = {}
+            if self.tree is not None:
+                for node in ast.walk(self.tree):
+                    end = getattr(node, "end_lineno", None)
+                    if isinstance(node, ast.stmt) and end is not None:
+                        for ln in range(node.lineno, end + 1):
+                            lines[ln] = node.lineno
+            self._stmt_lines = lines
+        return self._stmt_lines
+
+
+def scope_map(tree: ast.AST) -> Dict[ast.AST, str]:
+    """node -> dotted enclosing scope ("Class.method") for every function/
+    class body node. Used to anchor findings stably."""
+    scopes: Dict[ast.AST, str] = {}
+
+    def visit(node: ast.AST, scope: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_scope = scope
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                child_scope = f"{scope}.{child.name}" if scope else child.name
+            scopes[child] = child_scope
+            visit(child, child_scope)
+
+    scopes[tree] = ""
+    visit(tree, "")
+    return scopes
+
+
+def scan_tree(
+    root: str, rel_dirs: Sequence[str], rel_files: Sequence[str] = ()
+) -> List[ScannedFile]:
+    """Parse every ``*.py`` under ``root``'s ``rel_dirs`` plus the named
+    ``rel_files``; deterministic order (sorted relative paths)."""
+    picked: List[str] = []
+    for rel_dir in rel_dirs:
+        base = os.path.join(root, rel_dir)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__"
+            )
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    picked.append(os.path.join(dirpath, name))
+    for rel_file in rel_files:
+        path = os.path.join(root, rel_file)
+        if os.path.isfile(path):
+            picked.append(path)
+    out: List[ScannedFile] = []
+    for abs_path in sorted(set(picked)):
+        rel = os.path.relpath(abs_path, root)
+        try:
+            with open(abs_path, encoding="utf-8", errors="replace") as f:
+                source = f.read()
+        except OSError as e:
+            sf = ScannedFile(abs_path, rel, "")
+            sf.parse_error = str(e)
+            out.append(sf)
+            continue
+        out.append(ScannedFile(abs_path, rel, source))
+    return out
+
+
+def parse_error_findings(files: Iterable[ScannedFile]) -> List[Finding]:
+    return [
+        Finding(
+            rule="parse-error",
+            path=sf.rel,
+            line=1,
+            scope="",
+            detail="syntax",
+            message=f"file does not parse: {sf.parse_error}",
+        )
+        for sf in files
+        if sf.parse_error is not None
+    ]
+
+
+# ---------------------------------------------------------------- baseline
+
+
+def load_baseline(path: str) -> Tuple[Dict[str, int], List[str]]:
+    """(baseline counts, warnings). Missing file = empty baseline (the
+    bootstrap case). A malformed file WARNS and returns empty-with-skip
+    semantics via the warning — the gate must not wedge on a bad merge of
+    baseline.json (t1_budget/bench_gate convention), so callers treat a
+    warned-malformed baseline as 'skip the gate, exit 0'."""
+    warnings: List[str] = []
+    if not os.path.exists(path):
+        return {}, warnings
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+        if not isinstance(raw, dict):
+            raise ValueError("baseline must be a JSON object")
+        baseline = {}
+        for key, count in raw.items():
+            count = int(count)
+            if count <= 0:
+                # an entry zeroed instead of deleted must NOT keep
+                # grandfathering one violation — treat it as deleted (the
+                # finding gates, and the entry reports stale)
+                warnings.append(
+                    f"warning: baseline entry with count {count} treated "
+                    f"as deleted: {key}"
+                )
+                continue
+            baseline[str(key)] = count
+        return baseline, warnings
+    except (OSError, ValueError, TypeError) as e:
+        warnings.append(
+            f"warning: malformed baseline {path} ({e}) — baseline "
+            "comparison SKIPPED; fix or re-record it "
+            "(python -m tools.dedlint --write-baseline)"
+        )
+        return {}, warnings + ["__malformed__"]
+
+
+def gate_findings(
+    findings: Sequence[Finding], baseline: Dict[str, int]
+) -> Tuple[List[Finding], List[str]]:
+    """(new findings, stale-baseline notes).
+
+    A finding is covered while its key's baselined count is not exhausted;
+    the (count+1)-th identical violation is NEW. Baseline keys with no (or
+    fewer) remaining findings are stale: the violation was fixed, so the
+    entry must be deleted — grandfathering is a ratchet, not a cap."""
+    counts: Dict[str, int] = {}
+    new: List[Finding] = []
+    for f in findings:
+        seen = counts.get(f.key, 0) + 1
+        counts[f.key] = seen
+        if seen > baseline.get(f.key, 0):
+            new.append(f)
+    stale = []
+    for key, allowed in sorted(baseline.items()):
+        found = counts.get(key, 0)
+        if found >= allowed:
+            continue
+        if found:
+            # deleting the whole entry here would turn the REMAINING
+            # grandfathered violations into new findings — the right move
+            # is to shrink the count with the debt
+            stale.append(
+                f"stale baseline entry (partially fixed — lower its count "
+                f"to {found}): {key} (baselined {allowed}, found {found})"
+            )
+        else:
+            stale.append(
+                f"stale baseline entry (violation fixed — delete it): {key}"
+            )
+    return new, stale
+
+
+def baseline_payload(findings: Sequence[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    return dict(sorted(counts.items()))
+
+
+# ------------------------------------------------------------------ report
+
+
+def render_report(
+    findings: Sequence[Finding],
+    baseline: Dict[str, int],
+    stale: Sequence[str],
+    warnings: Sequence[str],
+    gate: bool,
+) -> str:
+    out: List[str] = []
+    out.extend(w for w in warnings if w != "__malformed__")
+    covered = 0
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+        is_new = counts[f.key] > baseline.get(f.key, 0)
+        if not is_new:
+            covered += 1
+        if gate and not is_new:
+            continue  # --gate output = only what fails the gate
+        tag = "" if is_new else "  [baselined]"
+        out.append(f"{f.render()}{tag}")
+    out.extend(stale)
+    new_count = len(findings) - covered
+    if gate:
+        if new_count:
+            out.append("")
+            out.append(
+                f"DEDLINT GATE FAILED: {new_count} new finding(s) not "
+                "covered by the baseline — fix them or (for deliberate "
+                "debt) add a dated entry to the baseline"
+            )
+        else:
+            out.append(
+                f"dedlint gate passed: 0 new findings "
+                f"({covered} baselined, {len(stale)} stale entr"
+                f"{'y' if len(stale) == 1 else 'ies'})"
+            )
+    else:
+        out.append("")
+        out.append(
+            f"{len(findings)} finding(s): {new_count} new, "
+            f"{covered} baselined"
+        )
+    return "\n".join(out)
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821 — py<3.11 typing
+    print(f"error: {msg}", file=sys.stderr)
+    sys.exit(2)
+
+
+# --------------------------------------------------------- name resolution
+
+
+def import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Local name -> dotted origin for every import in the module
+    (``import time as _time`` -> ``_time: time``; ``from time import
+    monotonic as m`` -> ``m: time.monotonic``)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted_name(expr: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Resolve ``a.b.c`` / imported names to a dotted origin string, or
+    None for anything dynamic (subscripts, calls)."""
+    parts: List[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    base = aliases.get(node.id, node.id)
+    parts.append(base)
+    return ".".join(reversed(parts))
+
+
+def call_name(node: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    return dotted_name(node.func, aliases)

@@ -167,9 +167,15 @@ def load_events(paths):
     return rows
 
 
-_FAULT_EVENTS = ("fault.applied", "fault.injected")
-_RETRY_EVENTS = ("state_sync.retry",)
-_ROUND_EVENTS = ("avg.round", "mm.form_group", "allreduce.round")
+# telemetry names come from the generated catalog (telemetry/events.py):
+# the dedlint schema checker guards the constants' emit sites, so a
+# producer rename breaks HERE at import instead of silently zeroing a view
+_repo_on_path()
+from dedloc_tpu.telemetry import events as ev  # noqa: E402
+
+_FAULT_EVENTS = (ev.FAULT_APPLIED, ev.FAULT_INJECTED)
+_RETRY_EVENTS = (ev.STATE_SYNC_RETRY,)
+_ROUND_EVENTS = (ev.AVG_ROUND, ev.MM_FORM_GROUP, ev.ALLREDUCE_ROUND)
 
 
 def _health_per_peer(rows):
@@ -188,19 +194,19 @@ def _health_per_peer(rows):
             stats["faults"] += 1
         elif event in _RETRY_EVENTS:
             stats["retries"] += 1
-        elif event == "state_sync.checksum_failure":
+        elif event == ev.STATE_SYNC_CHECKSUM_FAILURE:
             stats["checksum"] += 1
-        elif event == "rpc.client.failure":
+        elif event == ev.RPC_CLIENT_FAILURE:
             stats["rpc_fail"] += 1
-        elif event == "mm.join_failed":
+        elif event == ev.MM_JOIN_FAILED:
             stats["join_fail"] += 1
-        elif event == "opt.grads_dropped":
+        elif event == ev.OPT_GRADS_DROPPED:
             stats["dropped"] += 1
     return per_peer
 
 
 def _health_rounds(rows):
-    rounds = [r for r in rows if r["event"] == "avg.round"]
+    rounds = [r for r in rows if r["event"] == ev.AVG_ROUND]
     if not rounds:  # peers that never reached a full round: show what ran
         rounds = [r for r in rows if r["event"] in _ROUND_EVENTS]
     return rounds
@@ -208,7 +214,7 @@ def _health_rounds(rows):
 
 def _wire_per_peer(rows):
     """Per-peer pipelined-allreduce aggregates (reduce- vs wire-bound)."""
-    wire_rounds = [r for r in rows if r["event"] == "allreduce.round"
+    wire_rounds = [r for r in rows if r["event"] == ev.ALLREDUCE_ROUND
                    and ("reduce_s" in r or "gather_wait_s" in r)]
     per_peer_wire = {}
     for r in wire_rounds:
@@ -228,11 +234,11 @@ def _wire_per_peer(rows):
 def _ckpt_failures(rows):
     failures = {}
     for r in rows:
-        if r["event"] in ("ckpt.shard_fetch_failed",
-                          "ckpt.shard_verify_failure"):
+        if r["event"] in (ev.CKPT_SHARD_FETCH_FAILED,
+                          ev.CKPT_SHARD_VERIFY_FAILURE):
             acc = failures.setdefault(r.get("peer", "?"),
                                       {"fetch": 0, "verify": 0})
-            if r["event"] == "ckpt.shard_fetch_failed":
+            if r["event"] == ev.CKPT_SHARD_FETCH_FAILED:
                 acc["fetch"] += 1
             else:
                 acc["verify"] += 1
@@ -246,7 +252,7 @@ def _event_rates(rows):
     records. Only the rates this input can support are produced; the rest
     are skipped, never guessed."""
     rates = {}
-    forms = [r for r in rows if r["event"] == "mm.form_group"]
+    forms = [r for r in rows if r["event"] == ev.MM_FORM_GROUP]
     if forms:
         # form_group spans always stamp ok True/False, so from event logs
         # "aborted" and "attempted but never formed" are the SAME set —
@@ -256,7 +262,7 @@ def _event_rates(rows):
             sum(1 for r in forms if r.get("ok") is not True)
             / len(forms), 4
         )
-    lost = [r for r in rows if r["event"] == "rpc.conn_lost"]
+    lost = [r for r in rows if r["event"] == ev.RPC_CONN_LOST]
     ts = [r.get("t", 0.0) for r in rows]
     span_min = (max(ts) - min(ts)) / 60.0 if len(ts) >= 2 else 0.0
     if span_min > 0:
@@ -318,12 +324,12 @@ def health_data(rows):
         "checkpoint": {
             "manifests": [
                 simplify(r, "step", "shards", "bytes")
-                for r in rows if r["event"] == "ckpt.manifest_written"
+                for r in rows if r["event"] == ev.CKPT_MANIFEST_WRITTEN
             ],
             "restores": [
                 simplify(r, "mode", "ok", "dur_s", "shards", "bytes",
                          "providers")
-                for r in rows if r["event"] == "ckpt.restore"
+                for r in rows if r["event"] == ev.CKPT_RESTORE
             ],
             "shard_failures": _ckpt_failures(rows),
         },
@@ -392,8 +398,8 @@ def print_health(rows):
     # runbook): manifest writes from the coordinator, each peer's restore
     # span (sharded vs blob, wall, shards, providers), and the per-peer
     # shard fetch/verify failure counts the retry ladder absorbed
-    manifests = [r for r in rows if r["event"] == "ckpt.manifest_written"]
-    restores = [r for r in rows if r["event"] == "ckpt.restore"]
+    manifests = [r for r in rows if r["event"] == ev.CKPT_MANIFEST_WRITTEN]
+    restores = [r for r in rows if r["event"] == ev.CKPT_RESTORE]
     ckpt_failures = _ckpt_failures(rows)
     if manifests or restores or ckpt_failures:
         print("\ncheckpoint / restore:")
@@ -445,7 +451,7 @@ def _endpoint_map(rows):
     events — resolves the link destinations peers report into labels."""
     out = {}
     for r in rows:
-        if r.get("event") == "peer.endpoint" and r.get("endpoint"):
+        if r.get("event") == ev.PEER_ENDPOINT and r.get("endpoint"):
             out[str(r["endpoint"])] = r.get("peer", "?")
     return out
 
@@ -491,7 +497,7 @@ def trace_data(rows, round_key):
     ep_map = _endpoint_map(rows)
     spans = {r["span"]: r for r in trace_rows if r.get("span")}
     t0 = min(r.get("t", 0.0) for r in trace_rows)
-    hops = [r for r in trace_rows if r.get("event") == "allreduce.link"]
+    hops = [r for r in trace_rows if r.get("event") == ev.ALLREDUCE_LINK]
     doc = {
         "view": "trace",
         "round": round_key,
@@ -517,7 +523,7 @@ def trace_data(rows, round_key):
             "wait_s": float(worst.get("wait_s", 0.0)),
             "reduce_total_s": sum(
                 float(r.get("reduce_s", 0.0)) for r in trace_rows
-                if r.get("event") == "allreduce.round"
+                if r.get("event") == ev.ALLREDUCE_ROUND
             ),
         }
     return doc
@@ -555,14 +561,14 @@ def print_trace(rows, round_key):
         ok = r.get("ok")
         flag = "" if ok is None else (" ok" if ok else " FAILED")
         extra = ""
-        if r.get("event") == "allreduce.link":
+        if r.get("event") == ev.ALLREDUCE_LINK:
             extra = (
                 f" dst={_fmt_dst(r.get('dst'), ep_map)}"
                 f" wait={r.get('wait_s', 0.0):.3f}s"
                 f" send={r.get('send_s', 0.0):.3f}s"
                 f" bytes={int(r.get('sent_bytes', 0) + r.get('recv_bytes', 0))}"
             )
-        elif r.get("event") == "allreduce.stragglers":
+        elif r.get("event") == ev.ALLREDUCE_STRAGGLERS:
             extra = f" missing={r.get('missing')}"
         print(
             f"  +{r.get('t', 0.0) - t0:7.3f}s  {r.get('peer', '?'):<12} "
@@ -572,7 +578,7 @@ def print_trace(rows, round_key):
     # per-hop attribution: every member's allreduce.link rows say how long
     # it waited on each link; the host-side allreduce.round spans say how
     # much of a round was reduce CPU; straggler events mark SLA waits
-    hops = [r for r in trace_rows if r.get("event") == "allreduce.link"]
+    hops = [r for r in trace_rows if r.get("event") == ev.ALLREDUCE_LINK]
     if hops:
         print("\nper-hop wire time:")
         print("| src | dst | chunks | bytes | send | wait | max chunk |")
@@ -588,10 +594,10 @@ def print_trace(rows, round_key):
         worst = max(hops, key=lambda r: float(r.get("wait_s", 0.0)))
         reduce_total = sum(
             float(r.get("reduce_s", 0.0)) for r in trace_rows
-            if r.get("event") == "allreduce.round"
+            if r.get("event") == ev.ALLREDUCE_ROUND
         )
         stragglers = [
-            r for r in trace_rows if r.get("event") == "allreduce.stragglers"
+            r for r in trace_rows if r.get("event") == ev.ALLREDUCE_STRAGGLERS
         ]
         print(
             f"\ncritical path: {worst.get('peer', '?')} waited "
@@ -639,7 +645,7 @@ def _links_from_events(rows):
     scattered wire bytes over pure send wall, aggregated per (src, dst)."""
     latest = {}
     for r in rows:
-        if r.get("event") == "link.stats" and r.get("dst"):
+        if r.get("event") == ev.LINK_STATS and r.get("dst"):
             latest[(r.get("peer", "?"), str(r["dst"]))] = r
     if latest:
         out = []
@@ -654,7 +660,7 @@ def _links_from_events(rows):
         return out
     acc = {}
     for r in rows:
-        if r.get("event") != "allreduce.link" or not r.get("dst"):
+        if r.get("event") != ev.ALLREDUCE_LINK or not r.get("dst"):
             continue
         a = acc.setdefault(
             (r.get("peer", "?"), str(r["dst"])),
@@ -897,7 +903,7 @@ def _steps_from_events(rows):
     from the waterfall next to healthier peers."""
     per_peer = {}
     for r in rows:
-        if r.get("event") != "step.record":
+        if r.get("event") != ev.STEP_RECORD:
             continue
         acc = per_peer.setdefault(
             r.get("peer", "?"),
@@ -926,7 +932,7 @@ def _steps_from_events(rows):
     for r in rows:
         peer = r.get("peer", "?")
         if (
-            r.get("event") != "step.phase" or not r.get("phase")
+            r.get("event") != ev.STEP_PHASE or not r.get("phase")
             or peer in per_peer
         ):
             continue
@@ -1022,7 +1028,7 @@ def steps_data(all_rows):
             "JSONL needs swarm_health.peers[].phases)"
         )
     ledgers = [
-        r for r in event_rows if r.get("event") == "opt.overlap_ledger"
+        r for r in event_rows if r.get("event") == ev.OPT_OVERLAP_LEDGER
     ]
     hidden = sum(float(r.get("hidden_s", 0.0)) for r in ledgers)
     exposed = sum(float(r.get("exposed_s", 0.0)) for r in ledgers)
@@ -1119,7 +1125,7 @@ def print_steps(all_rows):
     # overlap ledger: hidden vs exposed averaging wall per boundary
     # (opt.overlap_ledger events; sync-fallback boundaries report
     # efficiency 0 — the round ran on the critical path)
-    ledgers = [r for r in event_rows if r.get("event") == "opt.overlap_ledger"]
+    ledgers = [r for r in event_rows if r.get("event") == ev.OPT_OVERLAP_LEDGER]
     if ledgers:
         t0 = min(r.get("t", 0.0) for r in ledgers)
         print("\noverlap ledger (per boundary):")
