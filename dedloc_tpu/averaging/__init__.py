@@ -6,4 +6,11 @@ from dedloc_tpu.averaging.partition import (
 )
 from dedloc_tpu.averaging.allreduce import GroupAllReduce, AllreduceFailed
 from dedloc_tpu.averaging.matchmaking import Matchmaking, GroupInfo
+from dedloc_tpu.averaging.topology import (
+    CliquePlan,
+    TopologyPlan,
+    clique_groups,
+    plan_from_groups,
+    plan_topology,
+)
 from dedloc_tpu.averaging.averager import DecentralizedAverager

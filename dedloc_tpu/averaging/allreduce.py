@@ -123,6 +123,14 @@ class _RoundState:
         self.local_span: Optional[np.ndarray] = None  # my fp32 span slice
         self.span_lo = 0
         self.reduce_s = 0.0  # CPU seconds spent in axpy/scale on this host
+        # hierarchical averaging (averaging/topology.py): a clique-level
+        # round runs in SUM mode — finalize serves the raw weighted sum
+        # (and its total weight) instead of the mean, so the clique's
+        # delegate can carry the weight-summed contribution into the WAN
+        # round without a divide/re-multiply that would change the math.
+        # Set by run() before expected_senders, so no chunk can finalize
+        # under the wrong mode.
+        self.normalize = True
 
     def chunk(self, c: int) -> _ChunkState:
         if c not in self.chunks:
@@ -136,12 +144,17 @@ class _RoundState:
         return marker.arrived if marker is not None else set()
 
     def accumulate(
-        self, c: int, part: np.ndarray, weight: float, own: bool = False
+        self, c: int, part: np.ndarray, weight: float, own: bool = False,
+        norm: Optional[float] = None,
     ) -> None:
         """Fold one sender's copy of chunk ``c`` into the eager accumulator.
         ``own=True`` marks a freshly-deserialized array the state may mutate
         in place; local slices (possibly views of the caller's reused flat
-        buffer) are copied first."""
+        buffer) are copied first. ``norm`` is the sender's NORMALIZATION
+        weight when it differs from its axpy scale: a hierarchical delegate
+        delivers its clique's pre-summed vector with ``weight=1`` (the sum
+        must not be re-scaled) but ``norm=W_clique`` (the denominator must
+        count every clique member it already folded in)."""
         st = self.chunk(c)
         t0 = telemetry.monotonic_clock()
         if st.acc is None:
@@ -153,7 +166,7 @@ class _RoundState:
         else:
             native.axpy(st.acc, part, weight)
         self.reduce_s += telemetry.monotonic_clock() - t0
-        st.weight += weight
+        st.weight += weight if norm is None else norm
 
     def maybe_finalize(self, c: int) -> None:
         """Resolve chunk ``c`` if every expected sender delivered it (data,
@@ -171,6 +184,15 @@ class _RoundState:
         path included). Requires run() to have initialized the round."""
         st = self.chunk(c)
         if st.done.done():
+            return
+        if not self.normalize:
+            # SUM mode (hierarchical clique round): serve the raw weighted
+            # sum — an empty accumulator is a legitimate zero sum (an
+            # all-aux/all-gated clique), not a fallback to local data
+            if st.acc is None:
+                lo, hi = self.chunk_bounds[c]
+                st.acc = np.zeros(hi - lo, dtype=np.float32)
+            st.done.set_result(st.acc)
             return
         if st.weight > 0:
             t0 = telemetry.monotonic_clock()
@@ -255,6 +277,10 @@ class GroupAllReduce:
         state = self._round(args["round_id"])
         sender = int(args["sender"])
         weight = float(args["weight"])
+        # a hierarchical delegate's normalization weight (its clique's
+        # summed weight) rides next to its axpy scale; plain senders omit
+        # the field and the two coincide
+        norm = float(args.get("norm", weight))
         c = int(args.get("chunk", 0))
         data = args.get("data")
         if data is None or c < 0:
@@ -273,14 +299,17 @@ class GroupAllReduce:
             return {}
         part = deserialize_array(data)
         if weight > 0:
-            state.accumulate(c, part, weight, own=True)
+            state.accumulate(c, part, weight, own=True, norm=norm)
         st.arrived.add(sender)
         state.maybe_finalize(c)
         return {}
 
     async def _rpc_get_reduced(self, peer: Endpoint, args) -> dict:
         """A member pulls one reduced chunk of my span (awaits until that
-        chunk finishes reducing — the streaming all-gather)."""
+        chunk finishes reducing — the streaming all-gather). The reply
+        carries the chunk's accumulated weight: sum-mode gatherers (the
+        hierarchical clique round) need the denominator their delegate
+        will advertise in the WAN round; mean-mode callers ignore it."""
         state = self._round(args["round_id"])
         st = state.chunk(int(args.get("chunk", 0)))
         data = await asyncio.wait_for(
@@ -288,7 +317,7 @@ class GroupAllReduce:
         )
         if st.wire is None:  # encode once, serve n-1 gatherers from cache
             st.wire = serialize_array(data, self.compression, checksum=True)
-        return {"data": st.wire}
+        return {"data": st.wire, "weight": st.weight}
 
     # ------------------------------------------------------------------ run
 
@@ -301,7 +330,9 @@ class GroupAllReduce:
         endpoints: Sequence[Optional[Endpoint]],
         bandwidths: Sequence[float],
         chunk_size: Optional[int] = None,
-    ) -> np.ndarray:
+        norm_weight: Optional[float] = None,
+        normalize: bool = True,
+    ):
         """Run one round. ``endpoints[i] is None`` marks a client-mode member
         (it hosts nothing); my own endpoint entry is ignored. Returns the
         weighted average vector (same shape as input) in a freshly allocated
@@ -313,6 +344,21 @@ class GroupAllReduce:
         the averager passes the group-negotiated value here, since chunk
         indices only mean the same thing when every member splits the
         identical spans with the identical chunk size.
+
+        Hierarchical (two-level) averaging hooks (averaging/topology.py):
+
+        - ``norm_weight`` decouples this member's NORMALIZATION weight from
+          its axpy scale ``weight`` — a clique delegate contributes its
+          clique's pre-summed vector with ``weight=1.0`` and
+          ``norm_weight=W_clique``, so the WAN mean divides by every
+          gradient the sum already carries without re-scaling the sum.
+        - ``normalize=False`` runs the round in SUM mode: hosts serve the
+          raw weighted sum and the return value becomes the tuple
+          ``(summed_vector, total_weight)`` — the contribution a delegate
+          carries up. The round FAILS (AllreduceFailed) when chunks
+          finalized with different total weights (a straggler was dropped
+          from part of the span): a delegate must never advertise a
+          denominator its sum does not actually carry.
         """
         n = len(endpoints)
         assert 0 <= my_index < n
@@ -332,6 +378,8 @@ class GroupAllReduce:
         hosts_span = hi > lo
         if hosts_span:
             my_state = self._round(round_id)
+            my_state.normalize = normalize  # before expected_senders: no
+            # chunk may finalize under the wrong mode
             my_state.expected_senders = set(senders)
             my_state.chunk_bounds = span_chunks(lo, hi, chunk_size)
             my_state.span_lo = lo
@@ -365,6 +413,7 @@ class GroupAllReduce:
                         self._run_inner(
                             round_id, my_index, vector, weight, endpoints,
                             spans, my_state, senders, ctx, chunk_size,
+                            norm_weight, normalize,
                         ),
                         timeout=self.timeout,
                     )
@@ -397,9 +446,13 @@ class GroupAllReduce:
 
     async def _run_inner(
         self, round_id, my_index, vector, weight, endpoints, spans, my_state,
-        senders, ctx, chunk_size,
-    ) -> np.ndarray:
+        senders, ctx, chunk_size, norm_weight=None, normalize=True,
+    ):
         n = len(endpoints)
+        norm = weight if norm_weight is None else float(norm_weight)
+        # sum-mode bookkeeping: every gathered chunk's total weight — the
+        # delegate's denominator, and the uniformity check's evidence
+        chunk_weights: List[float] = []
         tele = telemetry.resolve(self.telemetry)
         # per-destination wire accounting for THIS round: folded into the
         # link estimator (telemetry/links.py) per chunk, and emitted as one
@@ -445,6 +498,8 @@ class GroupAllReduce:
                     f"chunk size mismatch: got {data.size}, want {chi - clo}"
                 )
             np.copyto(out[clo:chi], data.reshape(-1), casting="unsafe")
+            if not normalize:
+                chunk_weights.append(float(reply.get("weight", 0.0)))
             if tele is not None:
                 raw = (chi - clo) * 4
                 dt = telemetry.monotonic_clock() - t0
@@ -469,6 +524,8 @@ class GroupAllReduce:
 
         async def fetch_own(c: int, clo: int, chi: int) -> None:
             data = await asyncio.shield(my_state.chunk(c).done)
+            if not normalize:
+                chunk_weights.append(float(my_state.chunk(c).weight))
             if self.compression is not CompressionType.NONE:
                 # adopt my own span THROUGH the wire codec: every other
                 # member decodes the lossy wire bytes, and synchronous-SGD
@@ -532,7 +589,9 @@ class GroupAllReduce:
                             # the roundtripped array is fresh (never a view
                             # of local_span), so the accumulator may adopt
                             # and scale it in place instead of copying again
-                            my_state.accumulate(c, part, weight, own=lossy)
+                            my_state.accumulate(
+                                c, part, weight, own=lossy, norm=norm
+                            )
                             my_state.chunk(c).arrived.add(my_index)
                     else:
                         my_state.chunk(-1).arrived.add(my_index)
@@ -573,13 +632,17 @@ class GroupAllReduce:
                     tele.counter("avg.bytes_saved").inc(
                         max(0, raw - len(payload))
                     )
+                part_args = {
+                    "round_id": round_id, "sender": my_index,
+                    "weight": weight, "chunk": c, "data": payload,
+                }
+                if norm != weight:
+                    # hierarchical delegate: axpy scale 1.0, denominator
+                    # W_clique — plain senders keep the smaller frame
+                    part_args["norm"] = norm
                 t0 = telemetry.monotonic_clock()
                 await self.client.call(
-                    endpoints[j], "avg.part",
-                    {
-                        "round_id": round_id, "sender": my_index,
-                        "weight": weight, "chunk": c, "data": payload,
-                    },
+                    endpoints[j], "avg.part", part_args,
                     timeout=self.timeout,
                 )
                 if tele is not None:
@@ -657,4 +720,22 @@ class GroupAllReduce:
                     wait_s=round(acc["wait_s"], 6),
                     max_chunk_s=round(acc["max_chunk_s"], 6),
                 )
+        if not normalize:
+            # SUM mode: the vector is only a valid clique contribution if
+            # every chunk's sum carries the SAME set of members — a chunk
+            # finalized short (straggler dropped mid-span) would make the
+            # delegate advertise a denominator its sum does not carry
+            if not chunk_weights:
+                raise AllreduceFailed(
+                    f"round {round_id}: sum mode gathered no chunks"
+                )
+            w0 = chunk_weights[0]
+            if any(abs(w - w0) > 1e-6 * max(1.0, abs(w0))
+                   for w in chunk_weights):
+                raise AllreduceFailed(
+                    f"round {round_id}: non-uniform chunk weights "
+                    f"{sorted(set(round(w, 9) for w in chunk_weights))} — "
+                    f"a straggler was dropped from part of the span"
+                )
+            return out, w0
         return out

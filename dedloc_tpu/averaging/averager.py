@@ -29,6 +29,7 @@ from dedloc_tpu.averaging.matchmaking import (
     MatchmakingFailed,
 )
 from dedloc_tpu.averaging.partition import FlatTree, TreeLayout
+from dedloc_tpu.averaging.topology import TopologyPlan
 from dedloc_tpu.checkpointing import (
     CheckpointAnnouncement,
     CheckpointManifest,
@@ -42,6 +43,7 @@ from dedloc_tpu.checkpointing import (
 )
 from dedloc_tpu.core.serialization import (
     CompressionType,
+    deserialize_array,
     deserialize_tree,
     pack_obj,
     serialize_array,
@@ -52,6 +54,7 @@ from dedloc_tpu.core.timeutils import get_dht_time
 from dedloc_tpu.dht.dht import DHT
 from dedloc_tpu.dht.protocol import RPCClient, RPCError, RPCServer
 from dedloc_tpu.telemetry import registry as telemetry
+from dedloc_tpu.telemetry.links import endpoint_key
 from dedloc_tpu.testing import faults
 from dedloc_tpu.utils.logging import get_logger
 
@@ -138,6 +141,11 @@ class DecentralizedAverager:
         # (one peer per process) leaves None and the process-global
         # registry — if installed — is used at each instrumented site
         telemetry_registry=None,
+        # hierarchical (two-level) averaging plan (averaging/topology.py;
+        # --averager.topology_plan): a TopologyPlan, or a path to its JSON.
+        # None / mode="flat" keeps today's flat butterfly. Installable
+        # later via set_topology_plan (e.g. replanned from live telemetry).
+        topology_plan=None,
         # dht/transport.py seam for this peer's averaging RPC server and
         # client: None = real TCP (production); the swarm simulator injects
         # its in-process network here
@@ -201,6 +209,13 @@ class DecentralizedAverager:
         self.endpoint = None
         self.last_group_size: int = 1
         self.last_contributors: int = 1
+        # hierarchical averaging state: the installed plan, and the fan-out
+        # futures a delegate publishes each round's final result through
+        # (clique members pull them via the avg.final RPC)
+        self._topology_plan: Optional[TopologyPlan] = None
+        self._hier_results: Dict[str, asyncio.Future] = {}
+        if topology_plan is not None:
+            self.set_topology_plan(topology_plan)
 
         # build server+matchmaking+allreduce on the DHT loop
         def _setup(node):
@@ -224,6 +239,9 @@ class DecentralizedAverager:
                         "ckpt.manifest", self._rpc_ckpt_manifest
                     )
                     self.server.register("ckpt.shard", self._rpc_ckpt_shard)
+                    # hierarchical averaging fan-out: clique members pull
+                    # the WAN round's final result from their delegate
+                    self.server.register("avg.final", self._rpc_hier_final)
                     await self.server.start()
                     self.endpoint = (self._advertised_host, self.server.port)
                     tele_setup = telemetry.resolve(self.telemetry)
@@ -231,8 +249,6 @@ class DecentralizedAverager:
                         # self-identification for the topology views: maps
                         # this peer's label to the endpoint other peers'
                         # link estimates name as their dst
-                        from dedloc_tpu.telemetry.links import endpoint_key
-
                         tele_setup.event(
                             "peer.endpoint",
                             endpoint=endpoint_key(self.endpoint),
@@ -528,6 +544,35 @@ class DecentralizedAverager:
         expected_size: Optional[int] = None,
         window: Optional[float] = None,
     ) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
+        plan = self._topology_plan
+        if plan is not None and plan.mode == "hierarchical":
+            return await self._step_hier(
+                tree, weight, round_id, expected_size, window, plan
+            )
+        return await self._step_flat(
+            tree, weight, round_id, expected_size, window
+        )
+
+    def _flatten(self, tree) -> np.ndarray:
+        """Flat fp32 view of ``tree`` in stable layout order, through the
+        reused TreeLayout buffer (valid until the next flatten — the
+        all-reduce reads it only within run())."""
+        if isinstance(tree, FlatTree):
+            # already flat in layout order: skip the host re-flatten pass
+            if self._layout is None or self._layout.spec != tree.spec:
+                self._layout = TreeLayout(tree.spec)
+            return tree.flat
+        if self._layout is None or not self._layout.matches(tree):
+            self._layout = TreeLayout.for_tree(tree)
+        # flatten into the layout's reused buffer: no astype/concatenate
+        # temporaries on the hot path
+        return self._layout.flatten_into(tree)
+
+    async def _step_flat(
+        self, tree, weight: float, round_id: str,
+        expected_size: Optional[int] = None,
+        window: Optional[float] = None,
+    ) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
         # device-flat contribution (averaging/device_flat.py FlatFetch):
         # the flat buffer is still streaming off the accelerator — resolve
         # it on an executor thread CONCURRENTLY with matchmaking, so the
@@ -572,18 +617,7 @@ class DecentralizedAverager:
         self.last_contributors = group.contributors
         if len(group.members) == 1:
             return (tree if weight > 0 else None), 1
-        if isinstance(tree, FlatTree):
-            # already flat in layout order: skip the host re-flatten pass
-            if self._layout is None or self._layout.spec != tree.spec:
-                self._layout = TreeLayout(tree.spec)
-            flat = tree.flat
-        else:
-            if self._layout is None or not self._layout.matches(tree):
-                self._layout = TreeLayout.for_tree(tree)
-            # flatten into the layout's reused buffer: no astype/concatenate
-            # temporaries on the hot path (valid until the next round's
-            # flatten — the all-reduce reads it only within run())
-            flat = self._layout.flatten_into(tree)
+        flat = self._flatten(tree)
         try:
             # the nonce is fresh per group assembly, so a retried round never
             # collides with _RoundState left over from a failed attempt
@@ -606,6 +640,307 @@ class DecentralizedAverager:
         # plus the flat buffer itself so a flat-native caller (the fused
         # flat apply) device_puts ONE array instead of per-leaf pieces
         return self._layout.tree_view(averaged), len(group.members)
+
+    # ---------------------------------------------- hierarchical averaging
+
+    def set_topology_plan(self, plan) -> None:
+        """Install (or clear, with None) the two-level averaging plan
+        (averaging/topology.py). Accepts a ``TopologyPlan`` or a path to
+        its JSON serialization. Takes effect on the next ``step``; the
+        plan is stamped onto the event trace so operators can see WHICH
+        hierarchy a round ran under."""
+        if isinstance(plan, str):
+            plan = TopologyPlan.load(plan)
+        self._topology_plan = plan
+        tele = telemetry.resolve(self.telemetry)
+        if tele is not None and plan is not None:
+            tele.event(
+                "avg.topology.plan", mode=plan.mode, reason=plan.reason,
+                cliques=len(plan.cliques),
+                planned_peers=sum(len(c.members) for c in plan.cliques),
+            )
+
+    def _hier_future(self, key: str) -> asyncio.Future:
+        """The fan-out future for one round's final result — created by
+        whichever side (delegate publish, member pull) gets there first,
+        and bounded like _RoundState entries so a key whose delegate never
+        publishes cannot leak."""
+        fut = self._hier_results.get(key)
+        if fut is None:
+            fut = asyncio.get_running_loop().create_future()
+            self._hier_results[key] = fut
+            asyncio.get_running_loop().call_later(
+                self.averaging_timeout * 2, self._hier_results.pop, key, None
+            )
+        return fut
+
+    async def _rpc_hier_final(self, peer, args) -> dict:
+        """A clique member pulls the round's final averaged vector from its
+        delegate (awaits until the delegate's WAN round lands). The reply
+        serves the delegate's cached wire encoding — one encode serves the
+        whole clique. A failed WAN leg parks an exception here, so members
+        fail FAST into the flat retry ladder instead of idling out their
+        timeout."""
+        fut = self._hier_future(str(args["round_id"]))
+        wire, group_size, contributors = await asyncio.wait_for(
+            asyncio.shield(fut), timeout=self.averaging_timeout
+        )
+        return {
+            "data": wire,
+            "group_size": group_size,
+            "contributors": contributors,
+        }
+
+    async def _step_hier(
+        self, tree, weight: float, round_id: str,
+        expected_size: Optional[int],
+        window: Optional[float],
+        plan: TopologyPlan,
+    ) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
+        """One two-level round (averaging/topology.py): clique members
+        reduce over cheap local links first (SUM mode — the raw weighted
+        sum and its total weight), the clique's delegate carries that
+        weight-summed contribution into the WAN butterfly round with
+        ``weight=1, norm_weight=W_clique`` (the WAN mean divides by every
+        gradient the sum carries without re-scaling it — delegation does
+        not change the math), and the result fans back out through the
+        delegate's ``avg.final``. Any failure at any rung — clique
+        matchmaking, the sum round, the WAN leg, a dead delegate — falls
+        back to ONE flat round of the same round_id (the PR 3 overlap
+        failure-ladder contract: the flat buffer still holds this peer's
+        grads, so the retry re-contributes them unchanged)."""
+        from dedloc_tpu.averaging.device_flat import FlatFetch
+
+        tele = telemetry.resolve(self.telemetry)
+        my_key = endpoint_key(self.endpoint) if self.endpoint else None
+        assignment = plan.assignment([my_key] if my_key else [])
+
+        async def fallback(reason: str, fetched_tree):
+            if tele is not None:
+                tele.counter("avg.topology.fallbacks").inc()
+                tele.event(
+                    "avg.topology.fallback", round_id=round_id,
+                    reason=reason,
+                )
+            return await self._step_flat(
+                fetched_tree, weight, round_id, expected_size, window
+            )
+
+        if assignment is None:
+            # a peer with no routable identity cannot be placed in a clique
+            return await fallback("no identity in plan", tree)
+        clique = assignment.clique
+        fan_key = f"{self.prefix}:{round_id}:fan:{clique.key()}"
+
+        # device-flat contribution: resolve the D2H transfer concurrently
+        # with the clique matchmaking, same as the flat path
+        fetch = None
+        if isinstance(tree, FlatFetch):
+            fetch = tree
+            tree = None
+            resolve_task = asyncio.get_running_loop().run_in_executor(
+                None, fetch.result
+            )
+        schema = (
+            spec_fingerprint(fetch.spec) if fetch is not None
+            else schema_fingerprint(tree)
+        )
+
+        async def settle() -> bool:
+            """Resolve the in-flight device fetch (idempotent); False when
+            the D2H failed — that loses the round on every path."""
+            nonlocal tree
+            if fetch is not None and tree is None:
+                try:
+                    tree = await resolve_task
+                except Exception as e:  # noqa: BLE001 — one round lost,
+                    # never the training process
+                    logger.warning(
+                        f"{round_id}: device-flat fetch failed: {e!r}"
+                    )
+                    return False
+            return True
+
+        # ---- level 1: the clique-local SUM round over cheap links
+        group = None
+        if assignment.clique_size > 1:
+            try:
+                group = await self.matchmaking.form_group(
+                    round_id, schema=schema,
+                    expected_size=assignment.clique_size,
+                    window=window, scope=f"clique:{clique.key()}",
+                )
+            except MatchmakingFailed as e:
+                logger.debug(f"clique matchmaking failed for {round_id}: {e}")
+                if not await settle():
+                    self.last_contributors = 0
+                    return None, 1
+                return await fallback("clique matchmaking failed", tree)
+        if not await settle():
+            self.last_contributors = 0
+            return None, 1
+        flat = self._flatten(tree)
+
+        sum_vec: Optional[np.ndarray] = None
+        w_sum = weight
+        delegate_ep = None
+        clique_members = 1
+        clique_contributors = 0 if (self.auxiliary or weight <= 0) else 1
+        if group is not None and len(group.members) > 1:
+            delegate_idx = next(
+                (
+                    i for i, m in enumerate(group.members)
+                    if m.endpoint is not None
+                    and endpoint_key(m.endpoint) == clique.delegate
+                ),
+                None,
+            )
+            if delegate_idx is None and not assignment.is_delegate:
+                # the peer that must carry our sum up never joined: there
+                # is nobody to pull the WAN result from
+                return await fallback("delegate absent from clique", tree)
+            if delegate_idx is not None:
+                delegate_ep = group.endpoints[delegate_idx]
+            clique_members = len(group.members)
+            clique_contributors = group.contributors
+            try:
+                sum_vec, w_sum = await self.allreduce.run(
+                    f"{self.prefix}:{round_id}:{group.nonce}",
+                    group.my_index, flat, weight,
+                    group.endpoints, group.bandwidths,
+                    chunk_size=group.chunk_size,
+                    normalize=False,
+                )
+            except AllreduceFailed as e:
+                logger.warning(f"clique sum failed for {round_id}: {e}")
+                return await fallback("clique sum round failed", tree)
+        # else: singleton clique (or nobody joined a delegate's round) —
+        # this peer IS its whole contribution and rides the WAN directly
+
+        # ---- level 2, member side: the delegate carries our sum up; pull
+        # the final result back from it
+        if not assignment.is_delegate:
+            if delegate_ep is None:
+                return await fallback("no delegate to pull from", tree)
+            try:
+                reply = await self.client.call(
+                    delegate_ep, "avg.final", {"round_id": fan_key},
+                    timeout=self.averaging_timeout,
+                )
+                averaged = deserialize_array(reply["data"])
+                if averaged.size != flat.size:
+                    raise ValueError(
+                        f"fan-out size mismatch: got {averaged.size}, "
+                        f"want {flat.size}"
+                    )
+            except (RPCError, ConnectionError, OSError, ValueError,
+                    asyncio.TimeoutError) as e:
+                logger.warning(f"{round_id}: delegate fan-out failed: {e!r}")
+                return await fallback("delegate died mid-round", tree)
+            group_size = int(reply.get("group_size", clique_members))
+            self.last_group_size = group_size
+            self.last_contributors = int(
+                reply.get("contributors", clique_contributors)
+            )
+            if tele is not None:
+                tele.counter("avg.topology.rounds").inc()
+                tele.event(
+                    "avg.topology.round", round_id=round_id, role="member",
+                    clique_size=clique_members, group_size=group_size,
+                    ok=True,
+                )
+            return self._layout.tree_view(averaged), group_size
+
+        # ---- level 2, delegate side: the WAN butterfly among delegates
+        fut = self._hier_future(fan_key)
+        wan_members = 1
+        wan_contributors = 0
+        try:
+            if faults._active is not None:  # fault injection (testing/faults.py)
+                fault = faults.fire(
+                    "averager.hier_wan", round_id=round_id,
+                    delegate=my_key or "",
+                )
+                if fault is not None:
+                    await faults.apply_transport_fault(fault, "hier WAN leg")
+            wan_group = await self.matchmaking.form_group(
+                round_id, schema=schema,
+                expected_size=assignment.wan_size, window=window,
+                scope="wan",
+            )
+            wan_members = len(wan_group.members)
+            wan_contributors = wan_group.contributors
+            if wan_members == 1:
+                if sum_vec is not None and w_sum > 0:
+                    # alone on the WAN: the clique mean IS the global mean
+                    # (scale by the reciprocal — the identical arithmetic
+                    # the flat host's finalize applies)
+                    averaged = sum_vec * np.float32(1.0 / w_sum)
+                elif clique_members == 1:
+                    # overall singleton round: flat singleton semantics
+                    if not fut.done():
+                        fut.set_exception(
+                            AllreduceFailed("singleton hierarchical round")
+                        )
+                    self.last_group_size = 1
+                    self.last_contributors = clique_contributors
+                    return (tree if weight > 0 else None), 1
+                else:
+                    averaged = None  # all-zero-weight clique, alone on WAN
+            elif sum_vec is not None:
+                averaged = await self.allreduce.run(
+                    f"{self.prefix}:{round_id}:{wan_group.nonce}",
+                    wan_group.my_index, sum_vec,
+                    1.0 if w_sum > 0 else 0.0,
+                    wan_group.endpoints, wan_group.bandwidths,
+                    chunk_size=wan_group.chunk_size,
+                    norm_weight=w_sum,
+                )
+            else:
+                # singleton clique: plain (flat-semantics) contribution
+                averaged = await self.allreduce.run(
+                    f"{self.prefix}:{round_id}:{wan_group.nonce}",
+                    wan_group.my_index, flat, weight,
+                    wan_group.endpoints, wan_group.bandwidths,
+                    chunk_size=wan_group.chunk_size,
+                )
+        except (MatchmakingFailed, AllreduceFailed, ConnectionError,
+                OSError) as e:
+            logger.warning(f"{round_id}: WAN leg failed: {e!r}")
+            if not fut.done():
+                # park the failure for the clique: members fail fast into
+                # their own flat retry instead of idling out a timeout
+                fut.set_exception(
+                    AllreduceFailed(f"delegate WAN leg failed: {e!r}")
+                )
+            return await fallback("wan leg failed", tree)
+        if averaged is None:
+            if not fut.done():
+                fut.set_exception(AllreduceFailed("nothing to average"))
+            self.last_group_size = clique_members
+            self.last_contributors = clique_contributors
+            return None, clique_members
+        # every replica must adopt bit-identical values: the clique decodes
+        # the fan-out WIRE bytes, so the delegate adopts its own result
+        # through the same codec (the flat path's wire_roundtrip contract)
+        wire = serialize_array(averaged, self.compression, checksum=True)
+        averaged = deserialize_array(wire)
+        group_size = clique_members + wan_members - 1
+        contributors = clique_contributors + max(
+            0, wan_contributors - (0 if self.auxiliary else 1)
+        )
+        if not fut.done():
+            fut.set_result((wire, group_size, contributors))
+        self.last_group_size = group_size
+        self.last_contributors = contributors
+        if tele is not None:
+            tele.counter("avg.topology.rounds").inc()
+            tele.event(
+                "avg.topology.round", round_id=round_id, role="delegate",
+                clique_size=clique_members, wan_size=wan_members,
+                group_size=group_size, ok=True,
+            )
+        return self._layout.tree_view(averaged), group_size
 
     # --------------------------------------------------------- state sharing
 

@@ -165,6 +165,11 @@ class CollaborativeOptimizer:
         chunk_size: int = DEFAULT_CHUNK_SIZE,  # elements per wire chunk in
         # the pipelined all-reduce; <= 0 restores monolithic spans (the
         # pre-pipeline wire format) — same contract as --averager.chunk_size
+        topology_plan=None,  # hierarchical two-level averaging plan
+        # (averaging/topology.py; --averager.topology_plan): a TopologyPlan
+        # or a path to its JSON. None / mode="flat" keeps the flat
+        # butterfly; failures inside a hierarchical round fall back to a
+        # flat retry of the same round automatically.
         error_feedback: bool = True,  # residual error feedback for lossy
         # wire compression: the previous round's quantization error is added
         # back into the next round's contribution, so float16/uint8 wire
@@ -268,6 +273,7 @@ class CollaborativeOptimizer:
             checkpoint_dir=checkpoint_dir,
             signed_subkey=signed_subkey,
             telemetry_registry=telemetry_registry,
+            topology_plan=topology_plan,
         )
         self.tracker = ProgressTracker(
             dht,

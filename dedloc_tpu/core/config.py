@@ -190,6 +190,16 @@ class AveragerArguments:
     # costs one backoff instead of a failed join
     state_sync_retries: int = 2
     state_sync_backoff: float = 0.5
+    # hierarchical (two-level) adaptive averaging (averaging/topology.py;
+    # docs/fleet.md "when to enable hierarchical averaging"): path to a
+    # TopologyPlan JSON partitioning the swarm into low-RTT cliques with
+    # one delegate each — clique members reduce over cheap local links,
+    # delegates carry the weight-summed contribution into the WAN round.
+    # Generate with ``runlog_summary --topology`` (plan section) from a
+    # run's link telemetry. Empty = today's flat butterfly; a plan whose
+    # mode is "flat" is also a no-op, and any mid-round failure falls
+    # back to a flat retry of the same round automatically.
+    topology_plan: str = ""
 
 
 @dataclass

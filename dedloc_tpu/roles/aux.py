@@ -86,6 +86,9 @@ def run_aux(
         bandwidth=args.averager.bandwidth,
         compression=args.averager.compression,
         chunk_size=args.averager.chunk_size,
+        # the whole swarm must share one hierarchy: an aux donor without
+        # the plan would advertise into the flat scope nobody else forms
+        topology_plan=args.averager.topology_plan or None,
         target_group_size=args.averager.target_group_size,
         averaging_expiration=args.averager.averaging_expiration,
         averaging_timeout=args.averager.averaging_timeout,

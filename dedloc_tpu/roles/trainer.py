@@ -247,6 +247,9 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         bandwidth=args.averager.bandwidth,
         compression=args.averager.compression,
         chunk_size=args.averager.chunk_size,
+        # hierarchical two-level averaging (--averager.topology_plan):
+        # clique-first reduction per the operator-installed plan
+        topology_plan=args.averager.topology_plan or None,
         error_feedback=args.optimizer.error_feedback,
         overlap_averaging=args.optimizer.overlap_averaging,
         target_group_size=args.averager.target_group_size,

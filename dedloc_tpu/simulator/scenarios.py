@@ -442,6 +442,41 @@ async def run_averaging_workload(swarm: SimSwarm,
     if len(participants) < 2:
         raise ValueError("averaging workload needs >= 2 live peers")
 
+    # two-level (hierarchical) topology — the ``topology`` spec key::
+    #
+    #     topology:
+    #       cliques: [[label, ...], ...]   # explicit member groups, or
+    #       clique_size: 16                # auto-chunk the roster
+    #       enabled: true                  # false = run FLAT but keep the
+    #                                      #   plan for WAN-byte accounting
+    #
+    # The plan comes from the SAME planner the runtime averager installs
+    # (averaging/topology.plan_from_groups), so the simulator sizes exactly
+    # the hierarchy production would run. With ``enabled`` the round shape
+    # becomes: clique members exchange over their (cheap) local links under
+    # a clique-scoped matchmaking group, delegates carry one span over the
+    # WAN among themselves, then members pull the fanned-out result from
+    # their delegate. ``enabled: false`` classifies the flat run's bytes
+    # against the same partition — the WAN-savings baseline.
+    from dedloc_tpu.averaging.topology import plan_from_groups
+
+    topo_spec = spec.get("topology") or None
+    plan = None
+    hier_enabled = False
+    if topo_spec:
+        labels = [p.label for p in participants]
+        if topo_spec.get("cliques"):
+            groups = [list(g) for g in topo_spec["cliques"]]
+        else:
+            size = max(1, int(topo_spec.get("clique_size", 16)))
+            groups = [labels[i:i + size] for i in range(0, len(labels), size)]
+        plan = plan_from_groups(groups, reason="simulator spec")
+        hier_enabled = (
+            bool(topo_spec.get("enabled", True))
+            and plan.mode == "hierarchical"
+        )
+    peer_by_label = {p.label: p for p in participants}
+
     # scripted mid-run faults (the watchdog scenario's levers): applied at
     # the START of their round, so detection-latency assertions can count
     # folds from a known onset
@@ -500,6 +535,11 @@ async def run_averaging_workload(swarm: SimSwarm,
         # server's uplink back to the requester (the pipelined gather leg)
         return {"data": b"\x00" * int(args["size"])}
 
+    async def _final(_peer, args):
+        # hierarchical fan-out: a clique member pulls the round's final
+        # vector from its delegate (the averager's avg.final contract)
+        return {"data": b"\x00" * int(args["size"])}
+
     for peer in participants:
         if peer.matchmaking is None or (
             peer.matchmaking.target_group_size != group_size
@@ -510,6 +550,7 @@ async def run_averaging_workload(swarm: SimSwarm,
             )
         peer.node.server.register("avg.part", _part)
         peer.node.server.register("avg.get_reduced", _reduced)
+        peer.node.server.register("avg.final", _final)
         # endpoint self-identification, same as production logs: lets any
         # consumer (twin fitter, --topology) resolve link dst -> label
         peer.telemetry.event(
@@ -536,10 +577,92 @@ async def run_averaging_workload(swarm: SimSwarm,
     per_peer_walls: Dict[str, List[float]] = {}
     ledger = {"hidden": 0.0, "exposed": 0.0}
     groups_formed = 0
+    formed_sizes: List[int] = []  # every formed group's size (unique nonce)
     exchange_failures = 0
 
+    async def one_link(peer, endpoint, round_id) -> None:
+        """One directed link's chunked scatter + pipelined gather — the
+        flat member exchange and both hierarchical legs all ride this."""
+        tele = peer.telemetry
+        acc = {"sent_bytes": 0.0, "recv_bytes": 0.0, "chunks_sent": 0.0,
+               "chunks_recv": 0.0, "send_s": 0.0, "wait_s": 0.0,
+               "max_chunk_s": 0.0}
+        gathers = []
+
+        async def gather_chunk(c: int, size: int) -> None:
+            g0 = loop.time()
+            reply = await peer.node.client.call(
+                endpoint, "avg.get_reduced",
+                {"round_id": round_id, "chunk": c, "size": size},
+                timeout=rpc_timeout,
+            )
+            dt = loop.time() - g0
+            acc["recv_bytes"] += len(reply["data"])
+            acc["chunks_recv"] += 1
+            acc["wait_s"] += dt
+            acc["max_chunk_s"] = max(acc["max_chunk_s"], dt)
+
+        try:
+            for c, off in enumerate(range(0, span_bytes, chunk_bytes)):
+                size = min(chunk_bytes, span_bytes - off)
+                s0 = loop.time()
+                await peer.node.client.call(
+                    endpoint, "avg.part",
+                    {"round_id": round_id, "sender": peer.label,
+                     "chunk": c, "data": b"\x00" * size},
+                    timeout=rpc_timeout,
+                )
+                dt = max(loop.time() - s0, 1e-9)
+                # the persistent estimator eats the scatter timing, the
+                # same seam production allreduce feeds
+                tele.links().observe_transfer(endpoint, size, dt)
+                acc["sent_bytes"] += size
+                acc["chunks_sent"] += 1
+                acc["send_s"] += dt
+                acc["max_chunk_s"] = max(acc["max_chunk_s"], dt)
+                # the reduced chunk streams back while later chunks are
+                # still being scattered — the pipelined gather
+                gathers.append(
+                    asyncio.ensure_future(gather_chunk(c, size))
+                )
+            await asyncio.gather(*gathers)
+        finally:
+            # a scatter failure leaves gather tasks in flight: cancel
+            # and DRAIN them, or their connection-reset exceptions land
+            # as "never retrieved" warnings on the loop
+            for g in gathers:
+                g.cancel()
+            if gathers:
+                await asyncio.gather(*gathers, return_exceptions=True)
+            key = (peer.label, str(endpoint[0]))
+            swarm_acc = link_acc.setdefault(
+                key, {"bytes": 0.0, "send_s": 0.0}
+            )
+            swarm_acc["bytes"] += acc["sent_bytes"]
+            swarm_acc["send_s"] += acc["send_s"]
+            tele.event(
+                "allreduce.link", round_id=round_id,
+                dst=endpoint_key(endpoint),
+                sent_bytes=int(acc["sent_bytes"]),
+                recv_bytes=int(acc["recv_bytes"]),
+                chunks_sent=int(acc["chunks_sent"]),
+                chunks_recv=int(acc["chunks_recv"]),
+                send_s=round(acc["send_s"], 6),
+                wait_s=round(acc["wait_s"], 6),
+                max_chunk_s=round(acc["max_chunk_s"], 6),
+            )
+
+    def _record_wall(peer, wall: float) -> None:
+        member_walls.append(wall)
+        per_peer_walls.setdefault(peer.label, []).append(wall)
+        # the member's wire wall IS its avg_wire step phase: the snapshot
+        # then carries step.phase.avg_wire.mean/count next to fwd_bwd, so
+        # a health fold over sim peers attributes wire-bound vs
+        # compute-bound exactly like a production flight-recorder peer
+        peer.telemetry.histogram("step.phase.avg_wire").observe(wall)
+
     async def member_exchange(peer, others, round_id) -> Optional[float]:
-        """One member's wire work for one round. Returns the member's
+        """One member's wire work for one flat round. Returns the member's
         exchange wall in virtual seconds, or None when a link failed."""
         nonlocal exchange_failures
         tele = peer.telemetry
@@ -547,82 +670,12 @@ async def run_averaging_workload(swarm: SimSwarm,
         # engine) — the report's round walls and the dumped avg.round
         # spans a fitter reads must agree
         t0 = tele.clock()
-
-        async def one_link(endpoint) -> None:
-            acc = {"sent_bytes": 0.0, "recv_bytes": 0.0, "chunks_sent": 0.0,
-                   "chunks_recv": 0.0, "send_s": 0.0, "wait_s": 0.0,
-                   "max_chunk_s": 0.0}
-            gathers = []
-
-            async def gather_chunk(c: int, size: int) -> None:
-                g0 = loop.time()
-                reply = await peer.node.client.call(
-                    endpoint, "avg.get_reduced",
-                    {"round_id": round_id, "chunk": c, "size": size},
-                    timeout=rpc_timeout,
-                )
-                dt = loop.time() - g0
-                acc["recv_bytes"] += len(reply["data"])
-                acc["chunks_recv"] += 1
-                acc["wait_s"] += dt
-                acc["max_chunk_s"] = max(acc["max_chunk_s"], dt)
-
-            try:
-                for c, off in enumerate(range(0, span_bytes, chunk_bytes)):
-                    size = min(chunk_bytes, span_bytes - off)
-                    s0 = loop.time()
-                    await peer.node.client.call(
-                        endpoint, "avg.part",
-                        {"round_id": round_id, "sender": peer.label,
-                         "chunk": c, "data": b"\x00" * size},
-                        timeout=rpc_timeout,
-                    )
-                    dt = max(loop.time() - s0, 1e-9)
-                    # the persistent estimator eats the scatter timing, the
-                    # same seam production allreduce feeds
-                    tele.links().observe_transfer(endpoint, size, dt)
-                    acc["sent_bytes"] += size
-                    acc["chunks_sent"] += 1
-                    acc["send_s"] += dt
-                    acc["max_chunk_s"] = max(acc["max_chunk_s"], dt)
-                    # the reduced chunk streams back while later chunks are
-                    # still being scattered — the pipelined gather
-                    gathers.append(
-                        asyncio.ensure_future(gather_chunk(c, size))
-                    )
-                await asyncio.gather(*gathers)
-            finally:
-                # a scatter failure leaves gather tasks in flight: cancel
-                # and DRAIN them, or their connection-reset exceptions land
-                # as "never retrieved" warnings on the loop
-                for g in gathers:
-                    g.cancel()
-                if gathers:
-                    await asyncio.gather(*gathers, return_exceptions=True)
-                key = (peer.label, str(endpoint[0]))
-                swarm_acc = link_acc.setdefault(
-                    key, {"bytes": 0.0, "send_s": 0.0}
-                )
-                swarm_acc["bytes"] += acc["sent_bytes"]
-                swarm_acc["send_s"] += acc["send_s"]
-                tele.event(
-                    "allreduce.link", round_id=round_id,
-                    dst=endpoint_key(endpoint),
-                    sent_bytes=int(acc["sent_bytes"]),
-                    recv_bytes=int(acc["recv_bytes"]),
-                    chunks_sent=int(acc["chunks_sent"]),
-                    chunks_recv=int(acc["chunks_recv"]),
-                    send_s=round(acc["send_s"], 6),
-                    wait_s=round(acc["wait_s"], 6),
-                    max_chunk_s=round(acc["max_chunk_s"], 6),
-                )
-
         with tele.span(
             "avg.round", trace_seed=round_id, round_id=round_id,
             group_size=len(others) + 1,
         ) as ctx:
             results = await asyncio.gather(
-                *(one_link(ep) for _label, ep in others),
+                *(one_link(peer, ep, round_id) for _label, ep in others),
                 return_exceptions=True,
             )
             failures = [r for r in results if isinstance(r, Exception)]
@@ -632,13 +685,87 @@ async def run_averaging_workload(swarm: SimSwarm,
                 exchange_failures += len(failures)
                 return None
         wall = tele.clock() - t0
-        member_walls.append(wall)
-        per_peer_walls.setdefault(peer.label, []).append(wall)
-        # the member's wire wall IS its avg_wire step phase: the snapshot
-        # then carries step.phase.avg_wire.mean/count next to fwd_bwd, so
-        # a health fold over sim peers attributes wire-bound vs
-        # compute-bound exactly like a production flight-recorder peer
-        tele.histogram("step.phase.avg_wire").observe(wall)
+        _record_wall(peer, wall)
+        return wall
+
+    async def hier_exchange(peer, asn, cg, wg, clique_done,
+                            round_id) -> Optional[float]:
+        """One peer's TWO-LEVEL wire work: the clique leg over (cheap)
+        local links, then either the WAN leg among delegates (delegate
+        role) or the fan-out pull from the delegate (member role — waits
+        for its clique's WAN leg to land first, the real serialization).
+        Emits the same avg.round / allreduce.link telemetry schema as the
+        flat exchange, plus avg.topology.round, so --topology and the twin
+        fitter consume the dump unchanged."""
+        nonlocal exchange_failures
+        tele = peer.telemetry
+        clique = asn.clique
+        is_delegate = peer.label == clique.delegate
+        my_ep = tuple(peer.endpoint)
+        done = clique_done.setdefault(clique.key(), asyncio.Event())
+        t0 = tele.clock()
+        with tele.span(
+            "avg.round", trace_seed=round_id, round_id=round_id,
+            group_size=len(cg.members) if cg is not None else 1,
+        ) as ctx:
+            try:
+                if cg is not None and len(cg.members) > 1:
+                    others = [
+                        tuple(m.endpoint) for m in cg.members
+                        if m.endpoint is not None
+                        and tuple(m.endpoint) != my_ep
+                    ]
+                    await asyncio.gather(
+                        *(one_link(peer, ep, round_id) for ep in others)
+                    )
+                if is_delegate:
+                    if wg is not None and len(wg.members) > 1:
+                        others = [
+                            tuple(m.endpoint) for m in wg.members
+                            if m.endpoint is not None
+                            and tuple(m.endpoint) != my_ep
+                        ]
+                        await asyncio.gather(
+                            *(one_link(peer, ep, round_id) for ep in others)
+                        )
+                    done.set()
+                else:
+                    # the fan-out is data-dependent on the WAN leg: wait
+                    # for the delegate to land, then pull the final vector
+                    await asyncio.wait_for(done.wait(), timeout=rpc_timeout)
+                    delegate_peer = peer_by_label.get(clique.delegate)
+                    if delegate_peer is None or not delegate_peer.alive:
+                        raise ConnectionResetError("delegate dead")
+                    g0 = loop.time()
+                    reply = await peer.node.client.call(
+                        tuple(delegate_peer.endpoint), "avg.final",
+                        {"round_id": round_id, "size": span_bytes},
+                        timeout=rpc_timeout,
+                    )
+                    # the fan-out payload rides the delegate->member link
+                    acc = link_acc.setdefault(
+                        (clique.delegate, str(peer.host)),
+                        {"bytes": 0.0, "send_s": 0.0},
+                    )
+                    acc["bytes"] += len(reply["data"])
+                    acc["send_s"] += max(loop.time() - g0, 1e-9)
+                ctx["ok"] = True
+                tele.event(
+                    "avg.topology.round", round_id=round_id,
+                    role="delegate" if is_delegate else "member",
+                    clique_size=len(cg.members) if cg is not None else 1,
+                    wan_size=len(wg.members) if wg is not None else 0,
+                    ok=True,
+                )
+            except Exception as e:  # noqa: BLE001 — counted, round goes on
+                ctx["ok"] = False
+                ctx["error"] = type(e).__name__
+                exchange_failures += 1
+                if is_delegate:
+                    done.set()  # a dead WAN leg must not park the clique
+                return None
+        wall = tele.clock() - t0
+        _record_wall(peer, wall)
         return wall
 
     # first/last boundary stamps: the samples/sec window. Defined over the
@@ -664,7 +791,6 @@ async def run_averaging_workload(swarm: SimSwarm,
             stamps["samples"] += samples_per_boundary
 
     async def one_round(r: int) -> None:
-        nonlocal groups_formed
         round_id = f"avground-{r:04d}"
         await apply_faults(r)
         alive = [p for p in participants if p.alive]
@@ -674,31 +800,78 @@ async def run_averaging_workload(swarm: SimSwarm,
             # critical path
             await acc_task
 
-        async def form(peer):
-            try:
-                return peer, await peer.matchmaking.form_group(round_id)
-            except Exception:  # noqa: BLE001 — counted via group=None
-                return peer, None
-
-        formed = await asyncio.gather(*(form(p) for p in alive))
         exchanges = []
         seen_nonces = set()
-        for peer, group in formed:
-            if group is None or len(group.members) < 2:
-                continue
-            if group.nonce not in seen_nonces:
+
+        def _count_group(group) -> None:
+            nonlocal groups_formed
+            if group is not None and group.nonce not in seen_nonces:
                 seen_nonces.add(group.nonce)
-                groups_formed += 1
-            if peer.endpoint is None:
-                continue
-            my_ep = tuple(peer.endpoint)
-            others = [
-                (m.peer_id, tuple(m.endpoint)) for m in group.members
-                if m.endpoint is not None and tuple(m.endpoint) != my_ep
-            ]
-            if not others:
-                continue
-            exchanges.append(member_exchange(peer, others, round_id))
+                formed_sizes.append(len(group.members))
+                if len(group.members) >= 2:
+                    groups_formed += 1
+
+        if hier_enabled:
+            # two-level round: clique-scoped groups assemble concurrently
+            # with (and invisible to) the delegates' WAN group, so 200
+            # concurrent joiners contend inside bounded cliques instead of
+            # one flat all-pairs melee
+            alive_labels = {p.label for p in alive}
+            n_cliques = len(plan.cliques)
+            clique_done: Dict[str, asyncio.Event] = {}
+
+            async def form_hier(peer):
+                asn = plan.assignment(peer.label)
+                clique = asn.clique
+                cg = wg = None
+                local = sum(
+                    1 for label in clique.members if label in alive_labels
+                )
+                try:
+                    if local > 1:
+                        cg = await peer.matchmaking.form_group(
+                            round_id, expected_size=local,
+                            scope=f"clique:{clique.key()}",
+                        )
+                    if peer.label == clique.delegate:
+                        wg = await peer.matchmaking.form_group(
+                            round_id, expected_size=n_cliques, scope="wan",
+                        )
+                except Exception:  # noqa: BLE001 — skipped this round
+                    return peer, asn, None, None, True
+                return peer, asn, cg, wg, False
+
+            formed = await asyncio.gather(*(form_hier(p) for p in alive))
+            for peer, asn, cg, wg, failed in formed:
+                _count_group(cg)
+                _count_group(wg)
+                if failed:
+                    continue
+                exchanges.append(
+                    hier_exchange(peer, asn, cg, wg, clique_done, round_id)
+                )
+        else:
+            async def form(peer):
+                try:
+                    return peer, await peer.matchmaking.form_group(round_id)
+                except Exception:  # noqa: BLE001 — counted via group=None
+                    return peer, None
+
+            formed = await asyncio.gather(*(form(p) for p in alive))
+            for peer, group in formed:
+                if group is None:
+                    continue
+                _count_group(group)
+                if len(group.members) < 2 or peer.endpoint is None:
+                    continue
+                my_ep = tuple(peer.endpoint)
+                others = [
+                    (m.peer_id, tuple(m.endpoint)) for m in group.members
+                    if m.endpoint is not None and tuple(m.endpoint) != my_ep
+                ]
+                if not others:
+                    continue
+                exchanges.append(member_exchange(peer, others, round_id))
         walls = [w for w in await asyncio.gather(*exchanges)
                  if w is not None]
         if overlap:
@@ -739,6 +912,14 @@ async def run_averaging_workload(swarm: SimSwarm,
         "overlap": overlap,
         "groups_formed": groups_formed,
         "exchange_failures": exchange_failures,
+        # every formed group's size (unique nonce, singletons INCLUDED —
+        # the flat-collapse signal is exactly the singleton flood)
+        "groups_total": len(formed_sizes),
+        "singleton_groups": sum(1 for s in formed_sizes if s == 1),
+        "group_size_median": (
+            percentile([float(s) for s in formed_sizes], 0.50)
+            if formed_sizes else 0.0
+        ),
     }
     duration = max(get_dht_time() - t_start, 1e-9)
     report["duration_s"] = round(duration, 3)
@@ -787,6 +968,40 @@ async def run_averaging_workload(swarm: SimSwarm,
     report["worst_links"] = [
         [src, dst, round(bps, 1)] for src, dst, bps in worst[:10]
     ]
+    if plan is not None:
+        # WAN-vs-local byte split against the plan's partition — computed
+        # for the hierarchical run AND (enabled: false) the flat baseline
+        # of the same spec, so the savings ratio compares like for like
+        wan = local = 0.0
+        wan_by_src: Dict[str, float] = {}
+        for (src, dst), acc in link_acc.items():
+            b = float(acc["bytes"])
+            if plan.same_clique(str(src), str(dst)):
+                local += b
+            else:
+                wan += b
+                wan_by_src[str(src)] = wan_by_src.get(str(src), 0.0) + b
+        delegates = set(plan.delegates)
+        nondelegates = [
+            p.label for p in participants if p.label not in delegates
+        ]
+        report["topology"] = {
+            "mode": "hierarchical" if hier_enabled else "flat",
+            "cliques": len(plan.cliques),
+            "delegates": sorted(delegates),
+            "wan_bytes_total": int(wan),
+            "local_bytes_total": int(local),
+            "wan_bytes_per_nondelegate": round(
+                sum(wan_by_src.get(label, 0.0) for label in nondelegates)
+                / max(1, len(nondelegates)),
+                1,
+            ),
+            "wan_bytes_per_delegate": round(
+                sum(wan_by_src.get(label, 0.0) for label in delegates)
+                / max(1, len(delegates)),
+                1,
+            ),
+        }
     if int(spec.get("restore_bytes", 0)) > 0:
         report["restore"] = await _workload_restore(swarm, spec, prefix)
     return report
@@ -1014,12 +1229,65 @@ async def _scenario_averaging(run: ScenarioRun) -> None:
     await phase_averaging(run)
 
 
+async def _scenario_hierarchical(run: ScenarioRun) -> None:
+    """Two-level adaptive averaging, sized against its own flat baseline
+    (docs/simulator.md): ONE swarm, the spec's ``topology`` partition and
+    per-link overrides (the 2-clique asymmetric-WAN shape), and the
+    averaging workload run TWICE — flat first (``topology.enabled: false``
+    keeps the plan for WAN-byte accounting), then hierarchical. The
+    ``comparison`` section is what the acceptance bounds read: WAN bytes
+    per non-delegate peer, round-wall p50, and the formed-group-size
+    medians the 200-joiner collapse case is judged on."""
+    await phase_spawn(run)
+    run.report["link_overrides"] = apply_link_overrides(
+        run.network,
+        [p.host for p in run.swarm.peers],
+        run.spec.get("links"),
+    )
+    topo = dict(run.spec.get("topology") or {})
+    flat_spec = {**run.spec, "topology": {**topo, "enabled": False}}
+    hier_spec = {**run.spec, "topology": {**topo, "enabled": True}}
+    run.report["flat"] = await run_averaging_workload(run.swarm, flat_spec)
+    run.report["hierarchical"] = await run_averaging_workload(
+        run.swarm, hier_spec
+    )
+    flat, hier = run.report["flat"], run.report["hierarchical"]
+
+    def _ratio(a: float, b: float) -> Optional[float]:
+        return round(a / b, 3) if b else None
+
+    flat_topo = flat.get("topology") or {}
+    hier_topo = hier.get("topology") or {}
+    run.report["comparison"] = {
+        "wan_bytes_total_ratio": _ratio(
+            flat_topo.get("wan_bytes_total", 0.0),
+            hier_topo.get("wan_bytes_total", 0.0),
+        ),
+        # the acceptance bar reads nondelegate_wan_bytes: two-level
+        # reduction must at least halve what a non-delegate pays WAN-side
+        # (it typically zeroes it — only delegates cross the WAN)
+        "nondelegate_wan_bytes": {
+            "flat": flat_topo.get("wan_bytes_per_nondelegate"),
+            "hierarchical": hier_topo.get("wan_bytes_per_nondelegate"),
+        },
+        "round_wall_p50_ratio": _ratio(
+            flat.get("round_wall_p50_s", 0.0),
+            hier.get("round_wall_p50_s", 0.0),
+        ),
+        "group_size_median": {
+            "flat": flat.get("group_size_median"),
+            "hierarchical": hier.get("group_size_median"),
+        },
+    }
+
+
 SCENARIOS: Dict[str, Callable] = {
     "dht_churn": _scenario_dht_churn,
     "matchmaking": _scenario_matchmaking,
     "catalog": _scenario_catalog,
     "mixed": _scenario_mixed,
     "averaging": _scenario_averaging,
+    "hierarchical": _scenario_hierarchical,
     "watchdog": _scenario_watchdog,
     # resolved specially by run_scenario: replays a fitted TwinModel
     # (dedloc_tpu/twin) instead of building a swarm from spec numbers

@@ -23,6 +23,11 @@ ALLREDUCE_ROUNDS = "allreduce.rounds"
 ALLREDUCE_STRAGGLERS = "allreduce.stragglers"
 AVG_BYTES_SAVED = "avg.bytes_saved"
 AVG_ROUND = "avg.round"
+AVG_TOPOLOGY_FALLBACK = "avg.topology.fallback"
+AVG_TOPOLOGY_FALLBACKS = "avg.topology.fallbacks"
+AVG_TOPOLOGY_PLAN = "avg.topology.plan"
+AVG_TOPOLOGY_ROUND = "avg.topology.round"
+AVG_TOPOLOGY_ROUNDS = "avg.topology.rounds"
 CKPT_FETCH_FAILURES = "ckpt.fetch_failures"
 CKPT_FETCH_RETRIES = "ckpt.fetch_retries"
 CKPT_MANIFEST_SERVE = "ckpt.manifest.serve"
@@ -121,6 +126,8 @@ COUNTERS = frozenset({
     "allreduce.rounds",
     "allreduce.stragglers",
     "avg.bytes_saved",
+    "avg.topology.fallbacks",
+    "avg.topology.rounds",
     "ckpt.fetch_failures",
     "ckpt.fetch_retries",
     "ckpt.manifests_written",
@@ -197,6 +204,9 @@ EVENTS = frozenset({
     "allreduce.round",
     "allreduce.stragglers",
     "avg.round",
+    "avg.topology.fallback",
+    "avg.topology.plan",
+    "avg.topology.round",
     "ckpt.manifest.serve",
     "ckpt.manifest_written",
     "ckpt.restore",

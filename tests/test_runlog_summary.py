@@ -374,6 +374,67 @@ def test_json_and_text_modes_agree_on_the_same_data(tmp_path, capsys):
     assert doc["per_peer"]["p0"]["dominant"] == "avg_wire"
 
 
+def test_topology_plan_section_previews_hierarchical_averaging(
+    tmp_path, capsys
+):
+    """ISSUE 15 satellite: --topology renders the two-level plan the
+    runtime planner (averaging/topology.py) would build from the SAME
+    folded link table — clique assignment + elected delegate as a `plan`
+    column on the links rows and a dedicated plan section — so operators
+    preview the hierarchy before enabling --averager.topology_plan."""
+    eps = {f"p{i}": f"127.0.0.1:{i + 1}" for i in range(4)}
+    rows = [
+        {"t": 1.0, "peer": p, "event": "peer.endpoint", "endpoint": ep}
+        for p, ep in eps.items()
+    ]
+    cliques = [("p0", "p1"), ("p2", "p3")]
+    fat = {"p1", "p3"}  # fattest uplink per clique: the elected delegates
+    for a, b in cliques:
+        for s, d in ((a, b), (b, a)):
+            rows.append({
+                "t": 2.0, "peer": s, "event": "link.stats", "dst": eps[d],
+                "rtt_s": 0.004,
+                "goodput_bps": 5e8 if s in fat else 1e8,
+                "bytes": 1000, "transfers": 3,
+            })
+    for s in ("p0", "p1"):
+        for d in ("p2", "p3"):
+            for src, dst in ((s, d), (d, s)):
+                rows.append({
+                    "t": 2.0, "peer": src, "event": "link.stats",
+                    "dst": eps[dst], "rtt_s": 0.12,
+                    "goodput_bps": 5e8 if src in fat else 1e8,
+                    "bytes": 1000, "transfers": 3,
+                })
+    path = _write_events(tmp_path, rows)
+
+    runlog_summary.main(["--json", "--topology", path])
+    doc = json.loads(capsys.readouterr().out)
+    plan = doc["plan"]
+    assert plan["mode"] == "hierarchical"
+    assert [c["members"] for c in plan["cliques"]] == [
+        ["p0", "p1"], ["p2", "p3"]
+    ]
+    assert [c["delegate"] for c in plan["cliques"]] == ["p1", "p3"]
+
+    runlog_summary.main(["--topology", path])
+    out = capsys.readouterr().out
+    assert "hierarchical plan (hierarchical): 2 cliques" in out
+    assert "| c0 | p1 | p0, p1 |" in out
+    assert "| c1 | p3 | p2, p3 |" in out
+    # the links table's plan column tags each src with its clique,
+    # delegates starred
+    assert "| plan |" in out
+    assert " c0* |" in out and " c1* |" in out
+
+    # a table too sparse for a hierarchy says so instead of hiding the
+    # section (the fallback the runtime would take too)
+    sparse = _write_events(tmp_path, rows[:5], name="sparse.jsonl")
+    runlog_summary.main(["--topology", sparse])
+    out = capsys.readouterr().out
+    assert "hierarchical plan (flat)" in out
+
+
 def test_topology_accepts_coordinator_folded_record(tmp_path, capsys):
     """--topology also renders a coordinator metrics JSONL whose
     swarm_health.topology already folded the per-peer link views."""

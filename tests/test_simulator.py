@@ -708,6 +708,79 @@ def test_scenario_matchmaking_contention_200_joiners():
     )
 
 
+def test_scenario_hierarchical_two_clique_asymmetric_wan_cuts_wan_cost():
+    """ISSUE 15 acceptance scenario: on a 2-clique spec with a slow
+    asymmetric WAN between the cliques, two-level reduction must cut WAN
+    bytes per non-delegate peer by >= 2x AND round-wall p50 versus the
+    flat run of the SAME spec (the scenario runs both over one swarm and
+    reports the comparison). Transfer-dominated sizing: the WAN link is
+    100x thinner than the local links, so the flat butterfly's all-pairs
+    WAN exchange is the round wall."""
+    from dedloc_tpu.simulator.scenarios import run_scenario
+
+    A = ["peer-0000", "peer-0001", "peer-0002"]
+    B = ["peer-0003", "peer-0004", "peer-0005"]
+    wan = [
+        {"src": s, "dst": d, "latency_s": 0.02, "bandwidth_bps": 2e6}
+        for s, d in [(s, d) for s in A for d in B]
+        + [(s, d) for s in B for d in A]
+    ]
+    report = run_scenario({
+        "scenario": "hierarchical", "peers": 6, "seed": 5,
+        "avg_rounds": 2, "group_size": 6, "window_s": 5.0,
+        "span_bytes": 262144, "chunk_bytes": 65536,
+        "boundaries": 1, "compute_s": 0.05,
+        "topology": {"cliques": [A, B]},
+        "link": {"latency_s": 0.001, "bandwidth_bps": 2e8},
+        "links": wan,
+    })
+    flat, hier = report["flat"], report["hierarchical"]
+    assert flat["exchange_failures"] == 0
+    assert hier["exchange_failures"] == 0
+    cmp = report["comparison"]
+    # >= 2x WAN-byte cut per non-delegate (in fact only delegates cross
+    # the WAN at all, so non-delegates drop to zero)
+    nd = cmp["nondelegate_wan_bytes"]
+    assert nd["flat"] > 0
+    assert nd["hierarchical"] * 2 <= nd["flat"]
+    # and the total swarm WAN traffic shrinks too: the delegates' single
+    # exchange replaces the all-pairs cross-clique butterfly
+    assert cmp["wan_bytes_total_ratio"] >= 2.0
+    # round-wall p50: the clique legs ride fat local links and only one
+    # span crosses the thin WAN, so the wall must strictly improve
+    assert hier["round_wall_p50_s"] < flat["round_wall_p50_s"]
+    assert cmp["round_wall_p50_ratio"] >= 1.5
+
+
+@pytest.mark.slow  # ~47s: 200 peers x 2 full workload runs (virtual time,
+# but the single-core box pays the event volume in real CPU seconds)
+def test_scenario_hierarchical_200_joiners_form_bounded_wan_rounds():
+    """ISSUE 15: the PR 7 collapse case — at 200 concurrent joiners, flat
+    matchmaking collapses mostly to singletons; clique-scoped formation
+    must instead fill bounded-size cliques (median formed-group size
+    strictly greater than flat's) with no livelock."""
+    from dedloc_tpu.simulator.scenarios import run_scenario
+
+    report = run_scenario({
+        "scenario": "hierarchical", "peers": 200, "seed": 7,
+        "avg_rounds": 1, "group_size": 16, "window_s": 1.5,
+        "span_bytes": 4096, "chunk_bytes": 4096,
+        "boundaries": 1, "compute_s": 0.01,
+        "topology": {"clique_size": 16},
+    })
+    flat, hier = report["flat"], report["hierarchical"]
+    # the collapse signal: flat's formed groups are mostly singletons
+    assert flat["singleton_groups"] > flat["groups_total"] // 2
+    assert flat["group_size_median"] <= 2.0
+    # clique-scoped rounds fill their bounded groups instead
+    assert hier["group_size_median"] > flat["group_size_median"]
+    assert hier["group_size_median"] >= 8.0
+    assert hier["singleton_groups"] == 0
+    # no livelock: every exchange the formed groups attempted completed
+    assert hier["exchange_failures"] == 0
+    assert hier["groups_formed"] >= len(range(0, 200, 16))
+
+
 def test_scenario_catalog_majority_digest_under_divergent_announcers():
     """ISSUE 9 scenario test: catalog selection holds majority-digest under
     divergent announcers, and the restore pulls from several providers."""

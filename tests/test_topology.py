@@ -1,0 +1,330 @@
+"""Hierarchical adaptive averaging (ISSUE 15).
+
+Two layers under test:
+
+- the topology planner (``averaging/topology.py``): clique detection over
+  the per-directed-link RTT table, delegate election by uplink capacity,
+  the paper's degenerate strategies falling out of the same planner (one
+  giant clique ⇒ flat all-reduce; fat listeners + thin client-mode
+  volunteers ⇒ de-facto parameter servers), and the flat fallbacks for
+  every input the hierarchy cannot justify (empty/sparse table, stale
+  links, client-mode-only cliques);
+- the two-level round itself over loopback (``averager._step_hier``): a
+  4-peer 2-clique swarm must produce BIT-IDENTICAL averaged results to
+  the flat path of the same contributions (weight-summed delegation does
+  not change the math), and a delegate killed mid-WAN-round must degrade
+  every affected peer into the flat retry ladder with its gradients
+  restored (the PR 3 overlap failure-ladder contract), asserted via
+  fault injection.
+"""
+import threading
+
+import numpy as np
+
+from dedloc_tpu.averaging.topology import (
+    CliquePlan,
+    TopologyPlan,
+    clique_groups,
+    plan_from_groups,
+    plan_topology,
+)
+
+# ------------------------------------------------------------ planner unit
+
+
+def _two_clique_links(fat=("a2", "b2")):
+    """Directed link table for two 2-peer cliques with a slow WAN between
+    them: intra-clique RTT well under the median, ``fat`` peers get the
+    biggest uplink (the delegate election's pick)."""
+    A, B = ["a1", "a2"], ["b1", "b2"]
+    links = []
+    for grp in (A, B):
+        for s in grp:
+            for d in grp:
+                if s != d:
+                    links.append({
+                        "src": s, "dst": d, "rtt_s": 0.004,
+                        "goodput_bps": 5e8 if s in fat else 1e8,
+                    })
+    for s in A:
+        for d in B:
+            for src, dst in ((s, d), (d, s)):
+                links.append({
+                    "src": src, "dst": dst, "rtt_s": 0.12,
+                    "goodput_bps": 5e8 if src in fat else 1e8,
+                })
+    return links
+
+
+def test_planner_two_cliques_elects_fattest_uplink():
+    plan = plan_topology(_two_clique_links())
+    assert plan.mode == "hierarchical"
+    assert [c.members for c in plan.cliques] == [["a1", "a2"], ["b1", "b2"]]
+    assert plan.delegates == ["a2", "b2"]
+    # assignment: member + delegate roles, WAN party count
+    asn = plan.assignment("a1")
+    assert not asn.is_delegate and asn.clique.delegate == "a2"
+    assert asn.wan_size == 2
+    assert plan.assignment("b2").is_delegate
+    # WAN-vs-local classifier (the simulator's wire accounting)
+    assert plan.same_clique("a1", "a2")
+    assert not plan.same_clique("a1", "b1")
+
+
+def test_planner_empty_and_sparse_tables_fall_back_flat():
+    assert plan_topology([]).mode == "flat"
+    # a single RTT observation is no evidence of a median to group under
+    one = [{"src": "a", "dst": "b", "rtt_s": 0.01}]
+    plan = plan_topology(one)
+    assert plan.mode == "flat"
+    assert "sparse" in plan.reason
+    # rate-only links (no rtt_s at all): same fallback
+    rates = [{"src": "a", "dst": "b", "goodput_bps": 1e8},
+             {"src": "b", "dst": "a", "goodput_bps": 1e8}]
+    assert plan_topology(rates).mode == "flat"
+    # flat plans assign nobody — the runtime keeps the flat butterfly
+    assert plan_topology([]).assignment("a") is None
+
+
+def test_planner_single_peer_is_flat():
+    links = [{"src": "solo", "dst": "solo", "rtt_s": 0.001},
+             {"src": "solo", "dst": "solo", "rtt_s": 0.002}]
+    assert plan_topology(links).mode == "flat"
+
+
+def test_planner_one_clique_covering_every_peer_is_flat():
+    """One giant clique ⇒ plain all-reduce (the paper's degenerate case):
+    a second level would only add a hop. Jittery samples — fast and slow
+    observations of the SAME pairs — must not fake a hierarchy."""
+    peers = ["a", "b", "c"]
+    links = []
+    for s in peers:
+        for d in peers:
+            if s != d:
+                links.append({"src": s, "dst": d, "rtt_s": 0.001})
+                links.append({"src": s, "dst": d, "rtt_s": 0.1})
+    plan = plan_topology(links)
+    assert plan.mode == "flat"
+    assert "single clique" in plan.reason
+
+
+def test_planner_client_mode_peer_never_elected_delegate():
+    """A client-mode peer cannot accept inbound connections, so it can
+    never host the WAN leg — even when it has the fattest uplink."""
+    plan = plan_topology(_two_clique_links(), client_peers=["a2", "b2"])
+    assert plan.mode == "hierarchical"
+    # a2/b2 are still clique MEMBERS, just not electable
+    assert [c.members for c in plan.cliques] == [["a1", "a2"], ["b1", "b2"]]
+    assert plan.delegates == ["a1", "b1"]
+    # an all-client clique cannot host the WAN leg at all: dropped from
+    # the plan (its members ride the WAN round directly, or — if nothing
+    # remains — the whole plan degrades flat)
+    assert plan_topology(
+        _two_clique_links(), client_peers=["a1", "a2", "b1", "b2"]
+    ).mode == "flat"
+
+
+def test_planner_stale_links_older_than_snapshot_window_dropped():
+    """Intra-clique evidence observed before the snapshot window must not
+    drive today's plan: with only fresh WAN links left, the planner falls
+    back flat; without the window, the same table plans a hierarchy."""
+    links = _two_clique_links()
+    for link in links:
+        link["t"] = 100.0 if link["rtt_s"] < 0.05 else 980.0
+    assert plan_topology(links).mode == "hierarchical"
+    stale = plan_topology(links, now=1000.0, stale_after_s=60.0)
+    assert stale.mode == "flat"
+
+
+def test_planner_thin_clients_attach_to_fat_listeners():
+    """The parameter-server degenerate case: thin client-mode volunteers
+    with no RTT clique of their own attach to the fattest listeners,
+    which become de-facto parameter servers (one singleton-rooted clique
+    per fat peer, volunteers spread round-robin)."""
+    links = _two_clique_links()
+    # three volunteers: only outbound rate observations, no RTT cliques
+    for v in ("v1", "v2", "v3"):
+        links.append({"src": v, "dst": "a2", "goodput_bps": 1e6})
+    plan = plan_topology(links, client_peers=["v1", "v2", "v3"])
+    assert plan.mode == "hierarchical"
+    volunteers = {"v1", "v2", "v3"}
+    homes = [c for c in plan.cliques if volunteers & set(c.members)]
+    assert homes, "volunteers were orphaned from the plan"
+    for c in homes:
+        assert c.delegate not in volunteers
+    assert volunteers <= {m for c in plan.cliques for m in c.members}
+
+
+def test_planner_unplanned_late_joiner_rides_wan_as_singleton():
+    plan = plan_topology(_two_clique_links())
+    asn = plan.assignment(["ghost:1234"])
+    assert asn is not None and asn.is_delegate
+    assert asn.clique.members == ["ghost:1234"]
+    assert asn.wan_size == len(plan.cliques) + 1
+
+
+def test_plan_roundtrip_and_stable_clique_scope(tmp_path):
+    plan = plan_topology(_two_clique_links())
+    path = str(tmp_path / "plan.json")
+    plan.save(path)
+    loaded = TopologyPlan.load(path)
+    assert loaded.to_dict() == plan.to_dict()
+    # the clique key is derived from the member SET: every peer holding
+    # the same plan derives the same matchmaking scope, no handshake
+    a = CliquePlan(members=["x", "y"], delegate="x")
+    b = CliquePlan(members=["y", "x"], delegate="y")
+    assert a.key() == b.key()
+    assert a.key() != CliquePlan(members=["x", "z"], delegate="x").key()
+
+
+def test_plan_from_groups_matches_detector_election():
+    """Operator/spec-driven plans (the simulator's ``topology.cliques``
+    key) use the same election rule as the detector-driven planner."""
+    plan = plan_from_groups(
+        [["p0", "p1"], ["p2", "p3"]], capacity={"p1": 2e8, "p3": 9e8}
+    )
+    assert plan.mode == "hierarchical"
+    assert plan.delegates == ["p1", "p3"]
+    assert plan_from_groups([["p0", "p1"]]).mode == "flat"
+    # shared detector: runlog_summary's promoted _clique_groups and the
+    # planner agree on the same table
+    median, groups = clique_groups(_two_clique_links())
+    assert groups == [["a1", "a2"], ["b1", "b2"]]
+    assert median == 0.12
+
+
+# --------------------------------------------------- loopback two-level
+
+
+def test_hierarchical_loopback_bit_identical_and_delegate_kill(rng):
+    """THE loopback validation (ISSUE 15 acceptance): a 4-peer, 2-clique
+    swarm averaged hierarchically must be BIT-IDENTICAL to the flat path
+    of the same contributions, and a delegate killed mid-WAN-round must
+    degrade every affected peer to the flat retry ladder with gradients
+    restored. Contributions are integer-valued (fp32-exact under any
+    accumulation order) with power-of-two total weight, so 'identical
+    math' is checkable as exact array equality."""
+    from dedloc_tpu.averaging import DecentralizedAverager
+    from dedloc_tpu.dht import DHT
+    from dedloc_tpu.telemetry.links import endpoint_key
+    from dedloc_tpu.testing.faults import FaultSchedule
+
+    n = 4
+    dhts = [DHT(start=True, listen_host="127.0.0.1")]
+    for _ in range(n - 1):
+        dhts.append(DHT(start=True, listen_host="127.0.0.1",
+                        initial_peers=[dhts[0].get_visible_address()]))
+    avgs = []
+    try:
+        for d in dhts:
+            avgs.append(DecentralizedAverager(
+                d, "hier", averaging_expiration=1.0, averaging_timeout=10.0,
+                listen_host="127.0.0.1", compression="none",
+            ))
+        keys = [endpoint_key(a.endpoint) for a in avgs]
+        plan = TopologyPlan(
+            mode="hierarchical", reason="test: 2 cliques of 2",
+            cliques=[
+                CliquePlan(members=sorted(keys[0:2]), delegate=keys[0]),
+                CliquePlan(members=sorted(keys[2:4]), delegate=keys[2]),
+            ],
+        )
+        # integer-valued grads < 2^8 and weights summing to a power of two:
+        # every weighted partial sum and the final divide are fp32-exact,
+        # so flat and hierarchical must agree to the BIT
+        trees = [
+            {"w": rng.integers(0, 256, 33).astype(np.float32),
+             "b": rng.integers(0, 256, 7).astype(np.float32)}
+            for _ in range(n)
+        ]
+        weights = [1.0, 1.0, 3.0, 3.0]
+        expected = {
+            leaf: sum(np.float32(w) * t[leaf]
+                      for w, t in zip(weights, trees)) * np.float32(1 / 8)
+            for leaf in ("w", "b")
+        }
+
+        def run_round(round_id, out, stagger=None, expected_size=None):
+            def one(i):
+                out[i] = avgs[i].step(
+                    trees[i], weights[i], round_id,
+                    expected_size=expected_size,
+                )
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(n)]
+            threads[0].start()
+            if stagger:
+                threads[0].join(timeout=0)  # already running; just pace
+                import time
+                time.sleep(stagger)
+            for th in threads[1:]:
+                th.start()
+            for th in threads:
+                th.join(timeout=30)
+            assert len(out) == n, f"{round_id}: a peer never returned"
+
+        # ---- hierarchical round: exact weighted mean on every peer
+        for a in avgs:
+            a.set_topology_plan(plan)
+        hier = {}
+        run_round("h1", hier)
+        for i in range(n):
+            tree, size = hier[i]
+            assert size > 1, f"peer {i} averaged alone"
+            for leaf in ("w", "b"):
+                np.testing.assert_array_equal(tree[leaf], expected[leaf])
+
+        # ---- flat baseline, same contributions: bit-identical results.
+        # peer 0 leads first (small stagger) so all four assemble into ONE
+        # flat group — the comparison needs the full-swarm flat mean
+        for a in avgs:
+            a.set_topology_plan(None)
+        flat = {}
+        run_round("f1", flat, stagger=0.3, expected_size=n)
+        for i in range(n):
+            ftree, fsize = flat[i]
+            assert fsize == n
+            htree, _ = hier[i]
+            for leaf in ("w", "b"):
+                assert np.array_equal(htree[leaf], ftree[leaf]), (
+                    f"peer {i} leaf {leaf}: hierarchical result is not "
+                    "bit-identical to the flat path"
+                )
+
+        # ---- delegate killed mid-WAN-round: clique 0's delegate drops at
+        # the WAN leg; it AND its member must degrade to the flat retry
+        # ladder with their grads restored (their flat 2-group mean is
+        # exact), while clique 1 completes as a clique-local mean (its
+        # delegate ends up alone on the WAN)
+        for a in avgs:
+            a.set_topology_plan(plan)
+        with FaultSchedule(seed=0) as schedule:
+            schedule.inject(
+                "averager.hier_wan", "drop",
+                match=lambda ctx: ctx["delegate"] == keys[0],
+            )
+            killed = {}
+            run_round("k1", killed)
+        assert [p for p, _ in schedule.fired] == ["averager.hier_wan"]
+        mean01 = {
+            leaf: (trees[0][leaf] + trees[1][leaf]) * np.float32(0.5)
+            for leaf in ("w", "b")
+        }
+        mean23 = {
+            leaf: (trees[2][leaf] + trees[3][leaf]) * np.float32(0.5)
+            for leaf in ("w", "b")
+        }
+        for i, want in ((0, mean01), (1, mean01), (2, mean23), (3, mean23)):
+            tree, size = killed[i]
+            assert size == 2, f"peer {i}: expected a 2-peer degraded round"
+            for leaf in ("w", "b"):
+                np.testing.assert_array_equal(
+                    tree[leaf], want[leaf],
+                    err_msg=f"peer {i} leaf {leaf}: grads were not restored"
+                    " intact into the retry round",
+                )
+    finally:
+        for a in avgs:
+            a.shutdown()
+        for d in reversed(dhts):
+            d.shutdown()

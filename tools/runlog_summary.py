@@ -737,38 +737,37 @@ def _collect_topology(all_rows):
 
 def _clique_groups(links):
     """(median rtt, clique candidate groups): peers whose pairwise RTT sits
-    well under the swarm median are same-datacenter material — the
-    hierarchical matchmaker's local-reduction groups (ROADMAP item 1)."""
-    rtts = sorted(
-        l["rtt_s"] for l in links if l.get("rtt_s") is not None
-    )
-    if len(rtts) < 2:
-        return None, []
-    median_rtt = rtts[len(rtts) // 2]
-    fast_pairs = [
-        (l["src"], l["dst_label"]) for l in links
-        if l.get("rtt_s") is not None and l["rtt_s"] <= 0.5 * median_rtt
-    ]
-    if not fast_pairs:
-        return median_rtt, []
-    # union-find over low-RTT pairs
-    parent = {}
+    well under the swarm median are same-datacenter material. The detector
+    itself was PROMOTED to shared library code
+    (``dedloc_tpu/averaging/topology.clique_groups``) so this view and the
+    runtime hierarchical planner can never disagree about what counts as a
+    clique; this wrapper only binds the view's ``dst_label`` key."""
+    from dedloc_tpu.averaging.topology import clique_groups
 
-    def find(x):
-        parent.setdefault(x, x)
-        while parent[x] != x:
-            parent[x] = parent[parent[x]]
-            x = parent[x]
-        return x
+    return clique_groups(links, dst_key="dst_label")
 
-    for a, b in fast_pairs:
-        parent[find(a)] = find(b)
-    cliques = {}
-    for node in parent:
-        cliques.setdefault(find(node), set()).add(node)
-    return median_rtt, sorted(
-        sorted(c) for c in cliques.values() if len(c) >= 2
-    )
+
+def _topology_plan(links):
+    """The two-level plan the runtime planner would build from this very
+    link table (averaging/topology.plan_topology with the view's
+    ``dst_label`` identity) — the operator preview of hierarchical
+    averaging BEFORE enabling it (--averager.topology_plan)."""
+    from dedloc_tpu.averaging.topology import plan_topology
+
+    return plan_topology(links, dst_key="dst_label")
+
+
+def _plan_assignment(plan):
+    """{peer label: "c<i>" (+"*" for the clique's delegate)} — the ``plan``
+    column of the links table, and the rendered plan section's rows."""
+    assignment = {}
+    for i, clique in enumerate(plan.cliques):
+        for member in clique.members:
+            tag = f"c{i}"
+            if member == clique.delegate:
+                tag += "*"
+            assignment[member] = tag
+    return assignment
 
 
 def _fat_thin(links):
@@ -801,6 +800,7 @@ def topology_data(all_rows):
     median_rtt, cliques = _clique_groups(links)
     _means, fat, thin = _fat_thin(links)
     worst = ranked[0]
+    plan = _topology_plan(links)
     return {
         "view": "topology",
         "links": ranked,
@@ -809,6 +809,10 @@ def topology_data(all_rows):
         "cliques": cliques,
         "fat_peers": fat,
         "thin_peers": thin,
+        # the hierarchical plan the runtime planner would install from the
+        # SAME folded table (averaging/topology.py) — preview before
+        # enabling --averager.topology_plan
+        "plan": plan.to_dict(),
     }
 
 
@@ -838,9 +842,13 @@ def print_topology(all_rows):
                 cells.append(f"{rtt_s} / {_fmt_rate(link.get('goodput_bps'))}")
         print(f"| {src} | " + " | ".join(cells) + " |")
 
+    plan = _topology_plan(links)
+    assignment = _plan_assignment(plan)
+
     print("\nlinks, worst first:")
-    print("| src | dst | rtt | goodput | chunk p50 | chunk max | bytes |")
-    print("|---|---|---|---|---|---|---|")
+    print("| src | dst | rtt | goodput | chunk p50 | chunk max | bytes |"
+          " plan |")
+    print("|---|---|---|---|---|---|---|---|")
     ranked = sorted(links, key=_link_sort_key)
     for link in ranked:
         rtt = link.get("rtt_s")
@@ -851,6 +859,7 @@ def print_topology(all_rows):
             f" {link.get('chunk_p50_s', 0.0):.3f}s |"
             f" {link.get('chunk_max_s', 0.0):.3f}s |"
             f" {int(link.get('bytes', 0))} |"
+            f" {assignment.get(link['src'], '-')} |"
         )
     worst = ranked[0]
     print(
@@ -875,6 +884,19 @@ def print_topology(all_rows):
             print(f"  fat:  {p} ({_fmt_rate(means[p])})")
         for p in thin:
             print(f"  thin: {p} ({_fmt_rate(means[p])})")
+
+    # the hierarchical plan the runtime planner (averaging/topology.py)
+    # would install from this same table — what --averager.topology_plan
+    # would actually run, previewed before enabling it
+    print(f"\nhierarchical plan ({plan.mode}): {plan.reason}")
+    if plan.mode == "hierarchical":
+        print("| clique | delegate | members |")
+        print("|---|---|---|")
+        for i, clique in enumerate(plan.cliques):
+            print(
+                f"| c{i} | {clique.delegate} |"
+                f" {', '.join(clique.members)} |"
+            )
 
 
 # ----------------------------------------------------------------- steps view
