@@ -29,6 +29,7 @@ from dedloc_tpu.averaging.matchmaking import (
     MatchmakingFailed,
 )
 from dedloc_tpu.averaging.partition import FlatTree, TreeLayout
+from dedloc_tpu.averaging.planwire import MAX_PLAN_FETCH_FAILURES, fetch_plan
 from dedloc_tpu.averaging.topology import TopologyPlan
 from dedloc_tpu.checkpointing import (
     CheckpointAnnouncement,
@@ -146,6 +147,15 @@ class DecentralizedAverager:
         # None / mode="flat" keeps today's flat butterfly. Installable
         # later via set_topology_plan (e.g. replanned from live telemetry).
         topology_plan=None,
+        # live re-planning (averaging/planwire.py): when True, ``step``
+        # polls the coordinator's epoch-versioned plan record between
+        # rounds and adopts the newest valid plan — the closed adaptation
+        # loop. Defaults OFF for bare averagers; the roles enable it unless
+        # the operator pinned a manual --averager.topology_plan (the
+        # opt-out, docs/fleet.md). Repeated fetch failures degrade to the
+        # held plan and ultimately to flat (MAX_PLAN_FETCH_FAILURES).
+        plan_follow: bool = False,
+        plan_refresh_period: float = 30.0,  # dht-time seconds between polls
         # dht/transport.py seam for this peer's averaging RPC server and
         # client: None = real TCP (production); the swarm simulator injects
         # its in-process network here
@@ -216,6 +226,17 @@ class DecentralizedAverager:
         self._hier_results: Dict[str, asyncio.Future] = {}
         if topology_plan is not None:
             self.set_topology_plan(topology_plan)
+        # live re-planning state: what we last adopted — (epoch, issued)
+        # orders records so a same-epoch republish with newer tuning is
+        # adopted without a scope reshuffle, and consecutive fetch failures
+        # are counted toward the degrade-to-flat threshold
+        self.plan_follow = bool(plan_follow)
+        self.plan_refresh_period = float(plan_refresh_period)
+        self.plan_tuning: Dict[str, Any] = {}
+        self._plan_epoch = 0
+        self._plan_issued = float("-inf")
+        self._plan_fetch_failures = 0
+        self._plan_next_refresh = 0.0
 
         # build server+matchmaking+allreduce on the DHT loop
         def _setup(node):
@@ -504,6 +525,12 @@ class DecentralizedAverager:
         it is waiting on are only NEAR the current step (they may never
         arrive; see CollaborationState.num_peers_near_step).
         """
+        if self.plan_follow:
+            try:
+                self.maybe_refresh_plan()
+            except Exception as e:  # noqa: BLE001 — a plan-refresh bug
+                # must never cost a training round
+                logger.warning(f"plan refresh failed: {e!r}")
 
         def _run(node):
             return self._step_async(
@@ -547,6 +574,10 @@ class DecentralizedAverager:
         plan = self._topology_plan
         if plan is not None and plan.mode == "hierarchical":
             return await self._step_hier(
+                tree, weight, round_id, expected_size, window, plan
+            )
+        if plan is not None and plan.mode == "gossip":
+            return await self._step_gossip(
                 tree, weight, round_id, expected_size, window, plan
             )
         return await self._step_flat(
@@ -641,6 +672,115 @@ class DecentralizedAverager:
         # flat apply) device_puts ONE array instead of per-leaf pieces
         return self._layout.tree_view(averaged), len(group.members)
 
+    # -------------------------------------------------- gossip averaging
+
+    async def _step_gossip(
+        self, tree, weight: float, round_id: str,
+        expected_size: Optional[int],
+        window: Optional[float],
+        plan: TopologyPlan,
+    ) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
+        """One gossip round (the planner's third interpolation point, for
+        very-unreliable swarms): average with a small deterministic
+        neighbor group instead of the whole swarm. Every same-plan peer
+        derives the identical per-round pairing from the plan roster
+        (``TopologyPlan.gossip_groups`` — seeded by epoch + round_id, so
+        pairs rotate every round and the swarm mixes over time), then runs
+        a plain flat all-reduce inside its pair's scope. A missing partner
+        is NOT a failure — the peer keeps its local values and mixes on a
+        future pairing (that locality is the point: one flaky peer costs
+        its pair a round, never the swarm). Matchmaking/allreduce errors
+        fall back to ONE flat round, the same ladder as hierarchical."""
+        from dedloc_tpu.averaging.device_flat import FlatFetch
+
+        tele = telemetry.resolve(self.telemetry)
+        my_key = endpoint_key(self.endpoint) if self.endpoint else None
+
+        async def fallback(reason: str, fetched_tree):
+            if tele is not None:
+                tele.counter("avg.topology.fallbacks").inc()
+                tele.event(
+                    "avg.topology.fallback", round_id=round_id,
+                    reason=reason,
+                )
+            return await self._step_flat(
+                fetched_tree, weight, round_id, expected_size, window
+            )
+
+        members = plan.gossip_group_of(
+            [my_key] if my_key else [], round_id
+        )
+        if members is None:
+            # not in the roster (late joiner since the plan was derived):
+            # ride a flat round until the next re-plan includes us
+            return await fallback("no identity in gossip roster", tree)
+
+        # device-flat contribution: resolve the D2H transfer concurrently
+        # with matchmaking, same as the flat path
+        fetch = None
+        if isinstance(tree, FlatFetch):
+            fetch = tree
+            tree = None
+            resolve_task = asyncio.get_running_loop().run_in_executor(
+                None, fetch.result
+            )
+        schema = (
+            spec_fingerprint(fetch.spec) if fetch is not None
+            else schema_fingerprint(tree)
+        )
+
+        async def settle() -> bool:
+            nonlocal tree
+            if fetch is not None and tree is None:
+                try:
+                    tree = await resolve_task
+                except Exception as e:  # noqa: BLE001 — one round lost,
+                    # never the training process
+                    logger.warning(
+                        f"{round_id}: device-flat fetch failed: {e!r}"
+                    )
+                    return False
+            return True
+
+        try:
+            group = await self.matchmaking.form_group(
+                round_id, schema=schema, expected_size=len(members),
+                window=window, scope=plan.gossip_scope(members),
+            )
+        except MatchmakingFailed as e:
+            logger.debug(f"gossip matchmaking failed for {round_id}: {e}")
+            if not await settle():
+                self.last_contributors = 0
+                return None, 1
+            return await fallback("gossip matchmaking failed", tree)
+        if not await settle():
+            self.last_contributors = 0
+            return None, 1
+        self.last_group_size = len(group.members)
+        self.last_contributors = group.contributors
+        if len(group.members) == 1:
+            # partner absent this round: local values carry forward and mix
+            # on a future pairing — by design, not a fallback
+            return (tree if weight > 0 else None), 1
+        flat = self._flatten(tree)
+        try:
+            averaged = await self.allreduce.run(
+                f"{self.prefix}:{round_id}:{group.nonce}",
+                group.my_index, flat, weight,
+                group.endpoints, group.bandwidths,
+                chunk_size=group.chunk_size,
+            )
+        except AllreduceFailed as e:
+            logger.warning(f"gossip round failed for {round_id}: {e}")
+            return await fallback("gossip round failed", tree)
+        if tele is not None:
+            tele.counter("avg.topology.rounds").inc()
+            tele.event(
+                "avg.topology.round", round_id=round_id, role="gossip",
+                group_size=len(group.members), ok=True,
+            )
+        return self._layout.tree_view(averaged), len(group.members)
+
     # ---------------------------------------------- hierarchical averaging
 
     def set_topology_plan(self, plan) -> None:
@@ -659,6 +799,86 @@ class DecentralizedAverager:
                 cliques=len(plan.cliques),
                 planned_peers=sum(len(c.members) for c in plan.cliques),
             )
+
+    # ------------------------------------------------- live plan following
+
+    def maybe_refresh_plan(self) -> None:
+        """Poll the coordinator's plan record (averaging/planwire.py) and
+        adopt the newest valid plan — called from ``step`` between rounds
+        when ``plan_follow`` is on, rate-limited to ``plan_refresh_period``
+        dht-time seconds. Adoption needs no barrier: the plan epoch is
+        embedded in every matchmaking scope, so peers mid-rollout form
+        disjoint (still valid) groups. The failure ladder: a transient
+        fetch failure keeps the current plan; ``MAX_PLAN_FETCH_FAILURES``
+        CONSECUTIVE failures degrade to flat with the reason named on the
+        ``avg.topology.fallback`` event — a dead coordinator demotes the
+        swarm, it never strands it."""
+        now = get_dht_time()
+        if now < self._plan_next_refresh:
+            return
+        self._plan_next_refresh = now + self.plan_refresh_period
+        record, reason = fetch_plan(self.dht, self.prefix)
+        if record is not None:
+            self._plan_fetch_failures = 0
+            self._adopt_plan_record(record)
+            return
+        if reason == "no plan record published":
+            # definitive absence, not a failure: the coordinator simply has
+            # not published (or its record expired intentionally) — a bare
+            # swarm stays on whatever plan it holds
+            self._plan_fetch_failures = 0
+            return
+        self._plan_fetch_failures += 1
+        if self._plan_fetch_failures < MAX_PLAN_FETCH_FAILURES:
+            logger.warning(
+                f"plan refresh failed ({self._plan_fetch_failures}/"
+                f"{MAX_PLAN_FETCH_FAILURES}): {reason} — keeping current plan"
+            )
+            return
+        if self._topology_plan is not None:
+            tele = telemetry.resolve(self.telemetry)
+            if tele is not None:
+                tele.counter("avg.topology.fallbacks").inc()
+                tele.event(
+                    "avg.topology.fallback", round_id="",
+                    reason=(
+                        f"plan refresh failed {self._plan_fetch_failures}x"
+                        f" consecutively ({reason}) — degrading to flat"
+                    ),
+                )
+            logger.warning(
+                f"degrading to flat topology: {self._plan_fetch_failures} "
+                f"consecutive plan fetch failures (last: {reason})"
+            )
+            self._topology_plan = None
+        # forget the held (epoch, issued) watermark so a recovered
+        # coordinator's republish of the SAME record is re-adoptable
+        self._plan_epoch = 0
+        self._plan_issued = float("-inf")
+
+    def _adopt_plan_record(self, record) -> None:
+        """Adopt ``record`` iff it is newer than what we hold: a higher
+        epoch (structural re-plan — new matchmaking scopes), or the same
+        epoch with a newer ``issued`` stamp (a tuning-only republish: the
+        actuated retune's distribution channel, no scope reshuffle)."""
+        newer = record.epoch > self._plan_epoch or (
+            record.epoch == self._plan_epoch
+            and record.issued > self._plan_issued
+        )
+        if not newer:
+            return
+        structural = record.epoch != self._plan_epoch
+        self._plan_epoch = int(record.epoch)
+        self._plan_issued = float(record.issued)
+        self.plan_tuning = dict(record.tuning or {})
+        chunk = self.plan_tuning.get("chunk_size")
+        if isinstance(chunk, (int, float)) and not isinstance(chunk, bool) \
+                and int(chunk) > 0:
+            # groups negotiate min-of-advertised chunk geometry, so a
+            # staggered rollout of a new size stays wire-compatible
+            self.chunk_size = int(chunk)
+        if structural:
+            self.set_topology_plan(record.topology_plan())
 
     def _hier_future(self, key: str) -> asyncio.Future:
         """The fan-out future for one round's final result — created by
@@ -730,7 +950,10 @@ class DecentralizedAverager:
             # a peer with no routable identity cannot be placed in a clique
             return await fallback("no identity in plan", tree)
         clique = assignment.clique
-        fan_key = f"{self.prefix}:{round_id}:fan:{clique.key()}"
+        # fan-out key embeds the (epoch-qualified) clique scope: a member
+        # and its delegate only exchange it when they formed the same
+        # epoch's clique group, so mixed-epoch rollouts can never cross
+        fan_key = f"{self.prefix}:{round_id}:fan:{plan.clique_scope(clique)}"
 
         # device-flat contribution: resolve the D2H transfer concurrently
         # with the clique matchmaking, same as the flat path
@@ -768,7 +991,9 @@ class DecentralizedAverager:
                 group = await self.matchmaking.form_group(
                     round_id, schema=schema,
                     expected_size=assignment.clique_size,
-                    window=window, scope=f"clique:{clique.key()}",
+                    # epoch-qualified scope: peers on different plan epochs
+                    # form disjoint groups during a re-plan rollout
+                    window=window, scope=plan.clique_scope(clique),
                 )
             except MatchmakingFailed as e:
                 logger.debug(f"clique matchmaking failed for {round_id}: {e}")
@@ -866,7 +1091,7 @@ class DecentralizedAverager:
             wan_group = await self.matchmaking.form_group(
                 round_id, schema=schema,
                 expected_size=assignment.wan_size, window=window,
-                scope="wan",
+                scope=plan.wan_scope(),
             )
             wan_members = len(wan_group.members)
             wan_contributors = wan_group.contributors

@@ -47,6 +47,13 @@ CLIQUE_RTT_FACTOR = 0.5
 # a fat peer serves >= this multiple of the median uplink capacity — the
 # parameter-server degenerate case attaches thin volunteers to these
 FAT_UPLINK_FACTOR = 2.0
+# when at least this fraction of the roster churns per health fold the
+# swarm is "very unreliable": full-swarm rounds keep dying mid-exchange, so
+# the planner selects gossip-style neighbor averaging (small deterministic
+# groups — a dead partner costs one pair's round, not the swarm's)
+GOSSIP_INSTABILITY_THRESHOLD = 0.25
+# gossip neighbor-group size: pairs, with one group of 3 on an odd roster
+GOSSIP_GROUP_SIZE = 2
 
 
 def clique_groups(links, dst_key: str = "dst"):
@@ -146,19 +153,91 @@ class Assignment:
 
 @dataclass
 class TopologyPlan:
-    """The planner's output: either ``mode="flat"`` (keep today's butterfly
-    — with ``reason`` saying why) or ``mode="hierarchical"`` with the
-    clique list. Serializable (``--averager.topology_plan`` file), and the
-    SAME object the ``runlog_summary --topology`` plan section renders."""
+    """The planner's output: ``mode="flat"`` (keep today's butterfly — with
+    ``reason`` saying why), ``mode="hierarchical"`` with the clique list, or
+    ``mode="gossip"`` with the ``peers`` roster (very-unreliable swarms:
+    deterministic neighbor pairs per round instead of full-swarm rounds).
+    Serializable (``--averager.topology_plan`` file), and the SAME object
+    the ``runlog_summary --topology`` plan section renders.
 
-    mode: str  # "flat" | "hierarchical"
+    ``epoch`` versions live re-planning (roles/coordinator.py publishes an
+    epoch-bumped plan record on material topology change; averager peers
+    adopt the newest between rounds). Matchmaking scopes embed the epoch —
+    see ``clique_scope``/``wan_scope``/``gossip_scope`` — so peers holding
+    epoch k and k+1 concurrently form DISJOINT groups during rollout: no
+    barrier, no handshake, a stale-plan peer just keeps averaging with its
+    own cohort until it fetches the new record. Epoch 0 (operator-pinned
+    files, pre-epoch plans) keeps the historical scope strings byte-for-
+    byte, so old plan files and old peers interoperate unchanged."""
+
+    mode: str  # "flat" | "hierarchical" | "gossip"
     reason: str
     cliques: List[CliquePlan] = field(default_factory=list)
     median_rtt_s: Optional[float] = None
+    epoch: int = 0
+    peers: List[str] = field(default_factory=list)  # gossip roster
 
     @property
     def delegates(self) -> List[str]:
         return [c.delegate for c in self.cliques]
+
+    # ------------------------------------------------------ matchmaking scopes
+
+    def clique_scope(self, clique: CliquePlan) -> str:
+        """The matchmaking scope a clique's local rounds form under.
+        Epoch-qualified so mixed-version rollouts never cross-join."""
+        if self.epoch:
+            return f"clique:e{self.epoch}:{clique.key()}"
+        return f"clique:{clique.key()}"
+
+    def wan_scope(self) -> str:
+        """The matchmaking scope the delegates' WAN round forms under."""
+        return f"wan:e{self.epoch}" if self.epoch else "wan"
+
+    def gossip_scope(self, members: Sequence[str]) -> str:
+        """The matchmaking scope one gossip neighbor group forms under."""
+        key = hashlib.sha256(
+            "\x00".join(sorted(members)).encode()
+        ).hexdigest()[:12]
+        return f"gossip:e{self.epoch}:{key}"
+
+    # ------------------------------------------------------- gossip pairing
+
+    def gossip_groups(self, round_id: str) -> List[List[str]]:
+        """Deterministic neighbor groups for one gossip round: the roster is
+        permuted by a hash of (epoch, round_id) and chunked into pairs (the
+        last group absorbs the odd peer). Every peer holding the same plan
+        derives the SAME pairing from the shared round id — no coordination
+        message, same trick as ``CliquePlan.key``. Pairings rotate every
+        round, so repeated gossip rounds mix the whole swarm."""
+        roster = sorted(set(self.peers))
+        if len(roster) < 2:
+            return [roster] if roster else []
+        digest = hashlib.sha256(
+            f"{self.epoch}\x00{round_id}".encode()
+        ).digest()
+        keyed = sorted(
+            roster,
+            key=lambda p: hashlib.sha256(digest + p.encode()).digest(),
+        )
+        groups = [
+            keyed[i:i + GOSSIP_GROUP_SIZE]
+            for i in range(0, len(keyed), GOSSIP_GROUP_SIZE)
+        ]
+        if len(groups) > 1 and len(groups[-1]) < GOSSIP_GROUP_SIZE:
+            groups[-2].extend(groups.pop())
+        return [sorted(g) for g in groups]
+
+    def gossip_group_of(self, member_ids, round_id: str) -> Optional[List[str]]:
+        """The neighbor group containing this peer (matched by any known
+        identity), or None when the peer is not in the gossip roster — the
+        runtime then falls back to a flat round with the reason named."""
+        ids = [member_ids] if isinstance(member_ids, str) else list(member_ids)
+        ids = {str(i) for i in ids if i}
+        for group in self.gossip_groups(round_id):
+            if ids & set(group):
+                return group
+        return None
 
     def assignment(self, member_ids) -> Optional[Assignment]:
         """This peer's assignment, matched by ANY of its known identities
@@ -203,6 +282,8 @@ class TopologyPlan:
             "mode": self.mode,
             "reason": self.reason,
             "median_rtt_s": self.median_rtt_s,
+            "epoch": int(self.epoch),
+            "peers": list(self.peers),
             "cliques": [
                 {"members": list(c.members), "delegate": c.delegate}
                 for c in self.cliques
@@ -223,6 +304,8 @@ class TopologyPlan:
             reason=str(raw.get("reason", "")),
             cliques=cliques,
             median_rtt_s=raw.get("median_rtt_s"),
+            epoch=int(raw.get("epoch", 0) or 0),
+            peers=[str(p) for p in raw.get("peers", [])],
         )
 
     def save(self, path: str) -> None:
@@ -257,6 +340,7 @@ def plan_topology(
     now: Optional[float] = None,
     stale_after_s: Optional[float] = None,
     dst_key: str = "dst",
+    instability: Optional[float] = None,
 ) -> TopologyPlan:
     """Partition the swarm described by ``links`` into a two-level plan.
 
@@ -268,6 +352,14 @@ def plan_topology(
     they are attached to the fattest listeners (the parameter-server
     degenerate case). ``stale_after_s`` (with ``now``) drops observations
     older than the snapshot window before planning.
+
+    ``instability`` is the caller's churn signal — the fraction of the
+    roster lost per recent health fold (``roles/coordinator.py`` derives it
+    from ``alive_peers`` deltas). At or above
+    ``GOSSIP_INSTABILITY_THRESHOLD`` the planner selects ``mode="gossip"``
+    (the paper's remaining degenerate strategy): full-swarm and delegate
+    rounds keep dying mid-exchange in such a swarm, so peers average with
+    deterministic per-round neighbor pairs instead.
 
     Falls back to ``mode="flat"`` — never raises — whenever the table is
     too sparse to justify a hierarchy, or when one clique already covers
@@ -281,6 +373,18 @@ def plan_topology(
     )
     if not peers:
         return TopologyPlan("flat", "empty link table")
+    if (
+        instability is not None
+        and instability >= GOSSIP_INSTABILITY_THRESHOLD
+        and len(peers) >= 3
+    ):
+        return TopologyPlan(
+            "gossip",
+            f"swarm instability {instability * 100.0:.0f}% >= "
+            f"{GOSSIP_INSTABILITY_THRESHOLD * 100.0:.0f}% per fold — "
+            "gossip neighbor averaging over deterministic per-round pairs",
+            peers=peers,
+        )
     median_rtt, groups = clique_groups(links, dst_key=dst_key)
     if median_rtt is None:
         return TopologyPlan(

@@ -77,6 +77,7 @@ def make_validators(
     chain: a malformed shard announcement is rejected at the storing node,
     and announcements published under a peer's owner-tag subkey are
     signature-bound to that peer (dedloc_tpu/checkpointing/catalog.py)."""
+    from dedloc_tpu.averaging.planwire import PlanRecord
     from dedloc_tpu.checkpointing.catalog import CheckpointAnnouncement
 
     signature = RSASignatureValidator(private_key)
@@ -84,6 +85,10 @@ def make_validators(
         {
             "metrics": LocalMetrics,
             "checkpoint_catalog": CheckpointAnnouncement,
+            # live re-planning records (averaging/planwire.py): a malformed
+            # or out-of-range topology plan is rejected at the storing
+            # node, not discovered mid-round by every adopting peer
+            "topology_plan": PlanRecord,
         },
         prefix=prefix,
     )

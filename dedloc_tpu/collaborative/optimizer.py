@@ -170,6 +170,11 @@ class CollaborativeOptimizer:
         # or a path to its JSON. None / mode="flat" keeps the flat
         # butterfly; failures inside a hierarchical round fall back to a
         # flat retry of the same round automatically.
+        plan_follow: bool = False,  # live re-planning (planwire.py):
+        # poll the coordinator's epoch-versioned plan record and adopt the
+        # newest valid plan between rounds; the roles enable this unless a
+        # manual topology_plan is pinned (the opt-out, docs/fleet.md)
+        plan_refresh_period: float = 30.0,
         error_feedback: bool = True,  # residual error feedback for lossy
         # wire compression: the previous round's quantization error is added
         # back into the next round's contribution, so float16/uint8 wire
@@ -274,6 +279,8 @@ class CollaborativeOptimizer:
             signed_subkey=signed_subkey,
             telemetry_registry=telemetry_registry,
             topology_plan=topology_plan,
+            plan_follow=plan_follow,
+            plan_refresh_period=plan_refresh_period,
         )
         self.tracker = ProgressTracker(
             dht,

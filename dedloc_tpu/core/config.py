@@ -200,6 +200,14 @@ class AveragerArguments:
     # mode is "flat" is also a no-op, and any mid-round failure falls
     # back to a flat retry of the same round automatically.
     topology_plan: str = ""
+    # live re-planning (averaging/planwire.py): follow the coordinator's
+    # epoch-versioned plan record on the DHT and adopt the newest valid
+    # plan between rounds — the closed adaptation loop (docs/fleet.md
+    # "closed-loop operations"). Pinning --averager.topology_plan above
+    # DISABLES following (the manual opt-out); plan_follow=false disables
+    # it outright even without a pin.
+    plan_follow: bool = True
+    plan_refresh_period: float = 30.0  # seconds between plan-record polls
 
 
 @dataclass

@@ -89,6 +89,12 @@ def run_aux(
         # the whole swarm must share one hierarchy: an aux donor without
         # the plan would advertise into the flat scope nobody else forms
         topology_plan=args.averager.topology_plan or None,
+        # and it must follow live re-plans for the same reason (unless the
+        # operator pinned a manual plan — pin = opt-out, docs/fleet.md)
+        plan_follow=(
+            args.averager.plan_follow and not args.averager.topology_plan
+        ),
+        plan_refresh_period=args.averager.plan_refresh_period,
         target_group_size=args.averager.target_group_size,
         averaging_expiration=args.averager.averaging_expiration,
         averaging_timeout=args.averager.averaging_timeout,

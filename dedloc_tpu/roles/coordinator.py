@@ -15,10 +15,12 @@ import dataclasses
 import json
 import os
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from dedloc_tpu.averaging.averager import DecentralizedAverager
+from dedloc_tpu.averaging.topology import TopologyPlan, plan_topology
 from dedloc_tpu.collaborative.metrics import aggregate_metrics, fetch_metrics
 from dedloc_tpu.core.config import CollaborationArguments, parse_config
 from dedloc_tpu.core.timeutils import get_dht_time
@@ -47,10 +49,35 @@ class CoordinatorExtraArguments:
     incident_log_path: str = "coordinator_incidents.jsonl"
     # ROADMAP item 4's closed loop: on a sustained throughput-regression
     # incident, fit a TwinModel from this coordinator's own metrics JSONL
-    # and attach a bounded-sweep retuning recommendation to the incident —
-    # recommendation ONLY, nothing is applied. Costs a few seconds of
-    # virtual-time replay, at most once per incident.
+    # and attach a bounded-sweep retuning recommendation to the incident.
+    # Costs a few seconds of virtual-time replay; at most once per incident
+    # — but a TRANSIENTLY failed fit (jammed JSONL mid-write, thread still
+    # busy) retries on a later fold instead of permanently attaching
+    # no_recommendation (retune_max_attempts bounds the retries).
     retune_on_regression: bool = True
+    retune_max_attempts: int = 3
+    # live topology re-planning (ISSUE 16 closed loop): derive a
+    # TopologyPlan from each health fold's link topology with the SAME
+    # plan_topology detector the --topology view uses, and publish it as an
+    # epoch-versioned signed DHT record (averaging/planwire.py) whenever
+    # the structure materially changes. Peers with plan-following enabled
+    # adopt it between rounds; peers pinned to --averager.topology_plan
+    # ignore it (the manual opt-out, docs/fleet.md).
+    replan: bool = True
+    # min seconds between epoch bumps — re-planning hysteresis so one noisy
+    # fold cannot thrash the swarm through plan epochs
+    replan_min_interval_s: float = 60.0
+    # guard-railed actuation (telemetry/watch.ActuationGuard): APPLY an
+    # eligible incident's twin recommendation, bounded per actuation and
+    # per plan epoch, auto-rolled-back when the post-change throughput
+    # regresses past the pre-change level. The applied config delta rides
+    # the plan record's tuning field to the peers. False = PR 12 behavior
+    # (recommendation only).
+    actuate_retune: bool = True
+    actuation_max_change_factor: float = 4.0
+    actuation_observe_folds: int = 3
+    actuation_rollback_margin: float = 0.1
+    actuation_max_per_epoch: int = 2
     # hub publication (run_first_peer.py:123-147 capability): a git working
     # tree (optionally pushing to hub_git_remote) or a directory mirror
     hub_git_dir: str = ""
@@ -136,6 +163,30 @@ def run_coordinator(
         from dedloc_tpu.telemetry.watch import SwarmWatch
 
         watch = SwarmWatch()
+    # live re-planning (ISSUE 16): epoch-versioned plan records derived
+    # from the health folds' link topology
+    replanner = (
+        _Replanner(dht, args.dht.experiment_prefix, extra)
+        if extra.replan else None
+    )
+    # guard-railed retune actuation: the applied config delta rides the
+    # plan record's tuning field; the launch config is the starting point
+    actuation = None
+    if extra.watchdog_enabled and extra.actuate_retune:
+        from dedloc_tpu.telemetry.watch import ActuationConfig, ActuationGuard
+
+        actuation = {
+            "guard": ActuationGuard(ActuationConfig(
+                max_change_factor=extra.actuation_max_change_factor,
+                observe_folds=extra.actuation_observe_folds,
+                rollback_margin=extra.actuation_rollback_margin,
+                max_actuations_per_epoch=extra.actuation_max_per_epoch,
+            )),
+            "config": {
+                "chunk_size": args.averager.chunk_size,
+                "overlap": args.optimizer.overlap_averaging,
+            },
+        }
     prev_health = None
     prev_fold_t = None
     current_step = -1
@@ -178,8 +229,13 @@ def run_coordinator(
                     f.write(json.dumps(agg) + "\n")
                 if wandb_run is not None:
                     wandb_run.log(agg, step=agg["step"])
+                if replanner is not None and health is not None:
+                    replanner.fold(health, agg["time"])
                 if watch is not None and health is not None:
-                    _watch_fold(watch, health, agg, extra, retunes)
+                    _watch_fold(
+                        watch, health, agg, extra, retunes,
+                        actuation=actuation, replanner=replanner,
+                    )
 
                 if (
                     averager is not None
@@ -208,6 +264,155 @@ def run_coordinator(
             averager.shutdown()
         tele_close()
         dht.shutdown()
+
+
+class _Replanner:
+    """Live topology re-planning off the coordinator's health folds
+    (ISSUE 16 tentpole 1). Each fold's link topology — the SAME fold the
+    ``--topology`` view renders — runs through ``plan_topology`` with the
+    member ids mapped to ENDPOINT KEYS (what averager matchmaking members
+    advertise); on a material structure change the epoch bumps and the plan
+    publishes as a signed DHT record (``averaging/planwire.py``). Recent
+    per-fold roster loss feeds the planner's ``instability`` signal, so a
+    very-unreliable swarm re-plans into gossip mode. Tuning-only updates
+    (the actuation guard's applied deltas) re-publish under the SAME epoch
+    with a newer ``issued`` stamp — scopes unchanged, no group reshuffle."""
+
+    def __init__(self, dht, prefix: str, extra) -> None:
+        self.dht = dht
+        self.prefix = prefix
+        self.extra = extra
+        self.epoch = 0
+        self.plan: Optional[TopologyPlan] = None
+        self.tuning: dict = {}
+        self._structure = None
+        self._loss_window = deque(maxlen=4)
+        self._prev_labels: set = set()
+        self._last_bump_t: Optional[float] = None
+
+    @staticmethod
+    def _endpoint_links(topology: dict) -> list:
+        """Fold links re-keyed by endpoint ("host:port") — plan member ids
+        must match what matchmaking members advertise, not the telemetry
+        labels the fold uses. Links whose endpoints the fold does not know
+        (client-mode peers) drop out; such peers ride any hierarchical
+        plan as direct-WAN singletons (TopologyPlan.assignment)."""
+        peers = topology.get("peers") or {}
+        out = []
+        for link in topology.get("links") or []:
+            if not isinstance(link, dict):
+                continue
+            src_ep = peers.get(link.get("src"))
+            dst_ep = link.get("dst_endpoint") or peers.get(link.get("dst"))
+            if not src_ep or not dst_ep:
+                continue
+            rec = dict(link)
+            rec["src"], rec["dst"] = str(src_ep), str(dst_ep)
+            out.append(rec)
+        return out
+
+    @staticmethod
+    def _shape(plan: TopologyPlan) -> tuple:
+        """The plan's material structure: what has to differ before an
+        epoch bump (reason strings and RTT medians churn every fold)."""
+        return (
+            plan.mode,
+            tuple((tuple(c.members), c.delegate) for c in plan.cliques),
+            tuple(sorted(plan.peers)),
+        )
+
+    def instability(self) -> Optional[float]:
+        if not self._loss_window:
+            return None
+        return sum(self._loss_window) / len(self._loss_window)
+
+    def fold(self, health: dict, t: float) -> Optional[TopologyPlan]:
+        """One health fold: update the churn window, derive a plan, and
+        publish on material change. Returns the newly published plan (or
+        None when nothing changed)."""
+        peers_rec = [
+            p for p in health.get("peers", []) if isinstance(p, dict)
+        ]
+        labels = {str(p.get("peer")) for p in peers_rec if p.get("peer")}
+        if self._prev_labels:
+            lost = self._prev_labels - labels
+            self._loss_window.append(
+                len(lost) / max(1, len(self._prev_labels))
+            )
+        self._prev_labels = labels
+        topology = health.get("topology")
+        if not isinstance(topology, dict):
+            return None
+        plan = plan_topology(
+            self._endpoint_links(topology), instability=self.instability()
+        )
+        if self._shape(plan) == self._structure:
+            return None
+        if self.plan is None and plan.mode == "flat":
+            # nothing published yet and the planner says "keep today's
+            # flat butterfly": publishing epoch 1 of the status quo would
+            # only reshuffle scopes for nothing
+            self._structure = self._shape(plan)
+            return None
+        if (
+            self._last_bump_t is not None
+            and t - self._last_bump_t < self.extra.replan_min_interval_s
+        ):
+            return None  # re-planning hysteresis: re-derived next fold
+        self.epoch += 1
+        plan.epoch = self.epoch
+        self.plan = plan
+        self._structure = self._shape(plan)
+        self._last_bump_t = t
+        self._publish(plan, t)
+        return plan
+
+    def push_tuning(self, tuning: dict, t: float) -> None:
+        """Distribute an actuated (or rolled-back) config delta: re-publish
+        the current record with the new tuning payload, same epoch."""
+        self.tuning = {
+            k: v for k, v in dict(tuning).items()
+            if isinstance(v, (int, float, bool))
+        }
+        plan = self.plan
+        if plan is None:
+            # no topology plan derived yet: a flat epoch-0 carrier record
+            # still distributes the tuning delta
+            plan = TopologyPlan(
+                "flat", "tuning-only record (no topology re-plan yet)"
+            )
+        self._publish(plan, t)
+
+    def _publish(self, plan: TopologyPlan, t: float) -> bool:
+        from dedloc_tpu.averaging.planwire import PlanRecord, publish_plan
+
+        record = PlanRecord(
+            epoch=int(plan.epoch),
+            plan=plan.to_dict(),
+            issued=float(t),
+            tuning=dict(self.tuning) if self.tuning else None,
+        )
+        ok = publish_plan(self.dht, self.prefix, record)
+        telemetry.inc("avg.topology.replans")
+        telemetry.event(
+            "avg.topology.replan",
+            epoch=int(plan.epoch),
+            mode=plan.mode,
+            reason=plan.reason,
+            cliques=len(plan.cliques),
+            published=bool(ok),
+        )
+        if ok:
+            logger.info(
+                f"published topology plan epoch {plan.epoch}: {plan.mode} "
+                f"({plan.reason})"
+            )
+        else:
+            logger.warning(
+                f"topology plan epoch {plan.epoch} publish failed after "
+                "retries; the swarm stays on the previous record"
+            )
+        return ok
 
 
 def _load_own_rows(path: str) -> list:
@@ -253,8 +458,13 @@ def _spawn_retune(incident, agg, extra, retunes) -> None:
     effects to the same dict while this thread runs."""
     prev = retunes.get("thread")
     if prev is not None and prev.is_alive():
-        incident["recommendation_reason"] = (
-            "retune skipped: a previous twin fit is still running"
+        # busy is TRANSIENT: attach nothing — the per-fold eligibility
+        # re-check in _watch_fold dispatches this incident again once the
+        # in-flight fit finishes (the old permanent "retune skipped"
+        # reason froze the incident without a recommendation forever)
+        logger.debug(
+            f"retune for {incident['id']} deferred: a previous twin fit "
+            "is still running"
         )
         return
 
@@ -271,11 +481,28 @@ def _spawn_retune(incident, agg, extra, retunes) -> None:
                 _load_own_rows(extra.metrics_log_path)
             )
         except Exception as e:  # noqa: BLE001 — a retune failure must
-            # never take the watchdog (or the coordinator) down with it
-            result = {"no_recommendation": f"retune failed: {e!r}"}
+            # never take the watchdog (or the coordinator) down with it.
+            # It is also usually TRANSIENT (the metrics JSONL jammed
+            # mid-write, a briefly-full disk): count the attempt and let
+            # the next fold retry; only a repeatedly-failing fit attaches
+            # a permanent reason.
             logger.warning(f"watchdog retune failed: {e!r}")
+            with retunes["lock"]:
+                attempts = int(incident.get("retune_attempts", 0)) + 1
+                incident["retune_attempts"] = attempts
+                if attempts >= max(1, extra.retune_max_attempts):
+                    incident["recommendation_reason"] = (
+                        f"retune failed after {attempts} attempts "
+                        f"(last: {e!r})"
+                    )
+                    _append_incident(
+                        extra, t, step, "recommendation", incident
+                    )
+            return
         with retunes["lock"]:
             if "no_recommendation" in result:
+                # a DEFINITIVE reason from the fit itself (insufficient
+                # coverage, unvalidated twin): attaching it is final
                 incident["recommendation_reason"] = (
                     result["no_recommendation"]
                 )
@@ -289,12 +516,15 @@ def _spawn_retune(incident, agg, extra, retunes) -> None:
     retunes["thread"].start()
 
 
-def _watch_fold(watch, health, agg, extra, retunes) -> None:
+def _watch_fold(watch, health, agg, extra, retunes,
+                actuation=None, replanner=None) -> None:
     """One watchdog fold inline in the coordinator loop: stream the fresh
     health record through the detectors, persist every incident transition
     to the incident JSONL (same directory as the metrics log), surface it
-    as a ``watch.incident`` telemetry event, and — at most once per
-    eligible incident — kick off the background twin retune.
+    as a ``watch.incident`` telemetry event, kick off the background twin
+    retune for eligible incidents (re-dispatched on later folds while a
+    transient failure left no recommendation attached), and drive the
+    actuation guard (apply → observe → keep-or-rollback).
 
     The WHOLE fold holds ``retunes["lock"]``: observe_health mutates live
     incident dicts (effects, severity, representative round) that the
@@ -311,11 +541,6 @@ def _watch_fold(watch, health, agg, extra, retunes) -> None:
         )
         for tr in transitions:
             incident = tr["incident"]
-            if (
-                tr["transition"] == "retune_eligible"
-                and extra.retune_on_regression
-            ):
-                _spawn_retune(incident, agg, extra, retunes)
             _append_incident(
                 extra, agg["time"], agg["step"], tr["transition"], incident
             )
@@ -338,6 +563,131 @@ def _watch_fold(watch, health, agg, extra, retunes) -> None:
                 f"{incident['severity']} {incident['kind']} "
                 f"{incident['subject']} ({incident['metric']})"
             )
+        if extra.retune_on_regression:
+            # per-fold re-check, not just the one-shot retune_eligible
+            # transition: an incident whose fit failed transiently (or was
+            # deferred behind an in-flight fit) carries neither a
+            # recommendation nor a reason yet and is dispatched again
+            for incident in watch.open_incidents():
+                if (
+                    incident.get("retune_eligible")
+                    and "recommendation" not in incident
+                    and "recommendation_reason" not in incident
+                ):
+                    _spawn_retune(incident, agg, extra, retunes)
+        if actuation is not None:
+            _actuation_fold(watch, agg, extra, actuation, replanner)
+
+
+def _incident_by_id(watch, incident_id):
+    for incident in watch.incidents:
+        if incident["id"] == incident_id:
+            return incident
+    return None
+
+
+def _actuation_fold(watch, agg, extra, actuation, replanner) -> None:
+    """Drive the actuation guard for one fold (caller holds the retune
+    lock): judge the in-flight actuation against this fold's throughput
+    (rolling it back when it regressed past the pre-change level), then
+    apply at most one new eligible recommendation under the guard rail.
+    Every actuation/rollback lands as an incident effect, an incident-JSONL
+    transition and a ``watch.actuation``/``watch.rollback`` event; the
+    resulting config delta rides the plan record's tuning field out to the
+    peers (``_Replanner.push_tuning``)."""
+    from dedloc_tpu.telemetry.watch import rollback_effect
+
+    guard = actuation["guard"]
+    epoch = replanner.epoch if replanner is not None else 0
+    t, step = agg["time"], agg["step"]
+    sps = agg.get("samples_per_second")
+
+    verdict = guard.observe(sps, fold=watch.fold)
+    if verdict is not None:
+        incident = _incident_by_id(watch, verdict.get("incident"))
+        if verdict["verdict"] == "rollback":
+            actuation["config"].update(verdict["revert"])
+            telemetry.inc("watch.rollbacks")
+            telemetry.event(
+                "watch.rollback",
+                incident_id=verdict.get("incident"),
+                applied=json.dumps(verdict["revert"]),
+                observed_samples_per_sec=(
+                    verdict["observed"][-1] if verdict["observed"] else None
+                ),
+                baseline_samples_per_sec=(
+                    verdict.get("baseline_samples_per_sec")
+                ),
+            )
+            logger.warning(
+                f"actuation rolled back for {verdict.get('incident')}: "
+                f"reverting {verdict['revert']} (post-change throughput "
+                "regressed past the pre-change level)"
+            )
+            if incident is not None:
+                rollback_effect(incident, verdict)
+                _append_incident(extra, t, step, "rollback", incident)
+            if replanner is not None:
+                replanner.push_tuning(actuation["config"], t)
+        else:  # kept
+            telemetry.event(
+                "watch.actuation",
+                incident_id=verdict.get("incident"),
+                applied=json.dumps(verdict["applied"]),
+                verdict="kept",
+            )
+            logger.info(
+                f"actuation kept for {verdict.get('incident')}: "
+                f"{verdict['applied']} held through "
+                f"{len(verdict['observed'])} fold(s)"
+            )
+            if incident is not None:
+                for effect in incident.get("effects", []):
+                    if (
+                        effect.get("metric") == "actuation"
+                        and effect.get("applied") == verdict["applied"]
+                    ):
+                        effect["verdict"] = "kept"
+                _append_incident(extra, t, step, "actuation", incident)
+
+    for incident in watch.open_incidents():
+        recommendation = incident.get("recommendation")
+        if not recommendation or incident.get("actuated"):
+            continue
+        result = guard.consider(
+            recommendation, actuation["config"],
+            fold=watch.fold, epoch=epoch,
+        )
+        if "refused" in result:
+            # NOT final — cooldowns expire and budgets reset with the next
+            # plan epoch, so the guard is re-consulted every fold
+            incident["actuation_refused"] = result["refused"]
+            continue
+        incident.pop("actuation_refused", None)
+        actuation["config"].update(result["apply"])
+        incident["actuated"] = True
+        guard.actuate(
+            incident, result["apply"], result["revert"],
+            fold=watch.fold, baseline_samples_per_sec=sps,
+            epoch=epoch, clamped=tuple(result["clamped"]),
+        )
+        telemetry.inc("watch.actuations")
+        telemetry.event(
+            "watch.actuation",
+            incident_id=incident["id"],
+            applied=json.dumps(result["apply"]),
+            verdict="applied",
+        )
+        logger.warning(
+            f"actuating twin recommendation for {incident['id']}: "
+            f"applying {result['apply']}"
+            + (f" (clamped: {result['clamped']})" if result["clamped"]
+               else "")
+        )
+        _append_incident(extra, t, step, "actuation", incident)
+        if replanner is not None:
+            replanner.push_tuning(actuation["config"], t)
+        break  # one actuation per fold; the guard serializes the rest
 
 
 def _pull_and_save(args, averager, step, upload_fn, uploads) -> None:

@@ -250,6 +250,12 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         # hierarchical two-level averaging (--averager.topology_plan):
         # clique-first reduction per the operator-installed plan
         topology_plan=args.averager.topology_plan or None,
+        # live re-planning: follow the coordinator's plan record UNLESS
+        # the operator pinned a manual plan (pin = opt-out, docs/fleet.md)
+        plan_follow=(
+            args.averager.plan_follow and not args.averager.topology_plan
+        ),
+        plan_refresh_period=args.averager.plan_refresh_period,
         error_feedback=args.optimizer.error_feedback,
         overlap_averaging=args.optimizer.overlap_averaging,
         target_group_size=args.averager.target_group_size,

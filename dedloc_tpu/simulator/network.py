@@ -450,6 +450,23 @@ class SimNetwork:
         self._uplink_busy_until.pop(host, None)
         return len(victims)
 
+    def reset_links(self, src_host: str, dst_host: str) -> int:
+        """Route-flap semantics for one host PAIR: every live connection
+        between the two hosts resets (both directions — a rerouted path
+        kills the TCP flows riding it), while both hosts stay alive and
+        reconnect lazily. The reconnect matters beyond realism: the link
+        RTT estimate samples on CONNECT (dht/protocol.py piggybacked
+        ping), so a latency change on a pooled connection is invisible to
+        telemetry until the flow re-opens — exactly as in production.
+        Returns how many connections were reset."""
+        victims = [
+            conn for conn in self._conns_by_host.get(src_host, ())
+            if dst_host in (conn.host(0), conn.host(1))
+        ]
+        for conn in victims:
+            conn.reset()
+        return len(victims)
+
 
 class SimTransport(Transport):
     """The per-peer face of a SimNetwork behind the ``dht/transport.py``

@@ -392,6 +392,7 @@ def _compute_for(spec: Dict[str, Any], peer) -> float:
 async def run_averaging_workload(swarm: SimSwarm,
                                  spec: Dict[str, Any],
                                  on_round: Optional[Callable] = None,
+                                 control: Optional[Dict[str, Any]] = None,
                                  ) -> Dict[str, Any]:
     """Drive ``avg_rounds`` averaging rounds over ``swarm`` and return the
     measured report section. Spec keys (all optional)::
@@ -416,7 +417,11 @@ async def run_averaging_workload(swarm: SimSwarm,
                                #    bandwidth_bps/latency_s/loss/jitter_s}
                                #     (omitted fields inherit the network
                                #      default; a second fault with healthy
-                               #      numbers restores the link)
+                               #      numbers restores the link;
+                               #      "reset_connections": true also kills
+                               #      the pair's pooled flows — the
+                               #      route-flap shape whose reconnects
+                               #      re-sample the link RTT)
                                #   {"kind": "straggler", "at_round": r,
                                #    "peer": label, "factor": 8.0}
                                #   {"kind": "churn", "at_round": r,
@@ -427,7 +432,16 @@ async def run_averaging_workload(swarm: SimSwarm,
     per remote hop — the event-log schema production peers write, so the
     twin fitter (and --topology/--steps) consume the dump unchanged.
     ``on_round(r)`` (optional coroutine) runs after each round completes —
-    the watchdog scenario's coordinator-fold hook."""
+    the watchdog scenario's coordinator-fold hook.
+
+    ``control`` (optional) is the LIVE control surface for the closed-loop
+    scenario: a mutable dict the ``on_round`` hook may update between
+    rounds — ``plan`` (a label-keyed ``TopologyPlan`` or None), ``enabled``
+    (run the plan vs account-only), ``chunk_bytes``. Each round re-reads it
+    before forming groups, mirroring the runtime averager's between-rounds
+    plan adoption (``maybe_refresh_plan``): a plan swap is just a new
+    matchmaking scope on the next round, no barrier. The initial values
+    seed from the spec, so a plain workload behaves exactly as before."""
     rounds = int(spec.get("avg_rounds", 4))
     group_size = int(spec.get("group_size", 8))
     span_bytes = max(1024, int(spec.get("span_bytes", 98304)))
@@ -477,6 +491,18 @@ async def run_averaging_workload(swarm: SimSwarm,
         )
     peer_by_label = {p.label: p for p in participants}
 
+    # live control surface (see docstring): one_round re-reads this dict,
+    # so the closed-loop controller can swap the plan / retune chunk_bytes
+    # between rounds exactly like runtime peers adopting a new plan record
+    live = control if control is not None else {}
+    live.setdefault("plan", plan)
+    live.setdefault(
+        "enabled",
+        bool(topo_spec.get("enabled", True)) if topo_spec else True,
+    )
+    live.setdefault("chunk_bytes", chunk_bytes)
+    live.setdefault("overlap", overlap)
+
     # scripted mid-run faults (the watchdog scenario's levers): applied at
     # the START of their round, so detection-latency assertions can count
     # folds from a known onset
@@ -508,6 +534,13 @@ async def run_averaging_workload(swarm: SimSwarm,
                         jitter_s=float(f.get("jitter_s", base.jitter_s)),
                     ),
                 )
+                if f.get("reset_connections"):
+                    # route-flap flavor: the latency change also kills the
+                    # pooled flows on the pair, so reconnects RE-SAMPLE the
+                    # link RTT — without this, connect-time RTT estimates
+                    # (and the re-planner's clique detection reading them)
+                    # stay blind to the change, exactly as in production
+                    swarm.network.reset_links(str(f["src"]), str(f["dst"]))
             elif kind == "straggler":
                 compute_scale[str(f["peer"])] = float(
                     f.get("factor", 4.0)
@@ -579,11 +612,16 @@ async def run_averaging_workload(swarm: SimSwarm,
     groups_formed = 0
     formed_sizes: List[int] = []  # every formed group's size (unique nonce)
     exchange_failures = 0
+    round_modes: List[str] = []  # per-round topology mode actually run
 
     async def one_link(peer, endpoint, round_id) -> None:
         """One directed link's chunked scatter + pipelined gather — the
         flat member exchange and both hierarchical legs all ride this."""
         tele = peer.telemetry
+        # chunk geometry re-read per link so a mid-run retune (the
+        # closed-loop controller's chunk_bytes actuation) takes effect on
+        # the next round, like the averager re-reading self.chunk_size
+        cb = max(1024, int(live["chunk_bytes"]))
         acc = {"sent_bytes": 0.0, "recv_bytes": 0.0, "chunks_sent": 0.0,
                "chunks_recv": 0.0, "send_s": 0.0, "wait_s": 0.0,
                "max_chunk_s": 0.0}
@@ -603,8 +641,8 @@ async def run_averaging_workload(swarm: SimSwarm,
             acc["max_chunk_s"] = max(acc["max_chunk_s"], dt)
 
         try:
-            for c, off in enumerate(range(0, span_bytes, chunk_bytes)):
-                size = min(chunk_bytes, span_bytes - off)
+            for c, off in enumerate(range(0, span_bytes, cb)):
+                size = min(cb, span_bytes - off)
                 s0 = loop.time()
                 await peer.node.client.call(
                     endpoint, "avg.part",
@@ -794,8 +832,19 @@ async def run_averaging_workload(swarm: SimSwarm,
         round_id = f"avground-{r:04d}"
         await apply_faults(r)
         alive = [p for p in participants if p.alive]
+        # the round's topology comes from the LIVE control dict: the
+        # closed-loop controller may have re-planned since last round
+        plan_r = live.get("plan")
+        mode_r = "flat"
+        if plan_r is not None and live.get("enabled", True):
+            if plan_r.mode in ("hierarchical", "gossip"):
+                mode_r = plan_r.mode
+        round_modes.append(mode_r)
+        # overlap is an actuation knob (ACTUATION_KEYS): a retune may flip
+        # it mid-run, so the round reads the live value, not the spec's
+        ov_r = bool(live.get("overlap", overlap))
         acc_task = asyncio.gather(*(accumulate(p, r) for p in alive))
-        if not overlap:
+        if not ov_r:
             # synchronous boundary: accumulate, THEN average on the
             # critical path
             await acc_task
@@ -811,32 +860,45 @@ async def run_averaging_workload(swarm: SimSwarm,
                 if len(group.members) >= 2:
                     groups_formed += 1
 
-        if hier_enabled:
+        if mode_r == "hierarchical":
             # two-level round: clique-scoped groups assemble concurrently
             # with (and invisible to) the delegates' WAN group, so 200
             # concurrent joiners contend inside bounded cliques instead of
-            # one flat all-pairs melee
+            # one flat all-pairs melee. Scopes are epoch-qualified via the
+            # plan (TopologyPlan.clique_scope/wan_scope), mirroring the
+            # runtime averager's mixed-version rollout isolation.
             alive_labels = {p.label for p in alive}
-            n_cliques = len(plan.cliques)
+            n_cliques = len(plan_r.cliques)
             clique_done: Dict[str, asyncio.Event] = {}
 
             async def form_hier(peer):
-                asn = plan.assignment(peer.label)
+                asn = plan_r.assignment(peer.label)
                 clique = asn.clique
                 cg = wg = None
                 local = sum(
                     1 for label in clique.members if label in alive_labels
                 )
                 try:
+                    # both rosters are known from the PLAN (not from each
+                    # other), so a delegate rendezvouses in its clique
+                    # scope and the WAN scope concurrently — the leader
+                    # handshake latency is paid once, not twice
+                    joins = []
                     if local > 1:
-                        cg = await peer.matchmaking.form_group(
+                        joins.append(peer.matchmaking.form_group(
                             round_id, expected_size=local,
-                            scope=f"clique:{clique.key()}",
-                        )
+                            scope=plan_r.clique_scope(clique),
+                        ))
                     if peer.label == clique.delegate:
-                        wg = await peer.matchmaking.form_group(
-                            round_id, expected_size=n_cliques, scope="wan",
-                        )
+                        joins.append(peer.matchmaking.form_group(
+                            round_id, expected_size=n_cliques,
+                            scope=plan_r.wan_scope(),
+                        ))
+                    groups = await asyncio.gather(*joins)
+                    if local > 1:
+                        cg = groups[0]
+                    if peer.label == clique.delegate:
+                        wg = groups[-1]
                 except Exception:  # noqa: BLE001 — skipped this round
                     return peer, asn, None, None, True
                 return peer, asn, cg, wg, False
@@ -850,6 +912,49 @@ async def run_averaging_workload(swarm: SimSwarm,
                 exchanges.append(
                     hier_exchange(peer, asn, cg, wg, clique_done, round_id)
                 )
+        elif mode_r == "gossip":
+            # gossip round: every peer averages inside its deterministic
+            # neighbor group (TopologyPlan.gossip_groups — derived from
+            # the shared round id, no coordination message), under the
+            # group's own matchmaking scope. A group whose partner died is
+            # skipped — that locality is gossip's whole point: one flaky
+            # peer costs its pair a round, never the swarm's round.
+            alive_labels = {p.label for p in alive}
+
+            async def form_gossip(peer, expected, scope):
+                try:
+                    return peer, await peer.matchmaking.form_group(
+                        round_id, expected_size=expected, scope=scope,
+                    )
+                except Exception:  # noqa: BLE001 — skipped this round
+                    return peer, None
+
+            joins = []
+            for members in plan_r.gossip_groups(round_id):
+                present = [m for m in members if m in alive_labels]
+                if len(present) < 2:
+                    continue
+                scope = plan_r.gossip_scope(members)
+                joins.extend(
+                    form_gossip(peer_by_label[m], len(present), scope)
+                    for m in present
+                )
+            formed = await asyncio.gather(*joins)
+            for peer, group in formed:
+                if group is None:
+                    continue
+                _count_group(group)
+                if len(group.members) < 2 or peer.endpoint is None:
+                    continue
+                my_ep = tuple(peer.endpoint)
+                others = [
+                    (m.peer_id, tuple(m.endpoint)) for m in group.members
+                    if m.endpoint is not None and tuple(m.endpoint) != my_ep
+                ]
+                if others:
+                    exchanges.append(
+                        member_exchange(peer, others, round_id)
+                    )
         else:
             async def form(peer):
                 try:
@@ -874,7 +979,7 @@ async def run_averaging_workload(swarm: SimSwarm,
                 exchanges.append(member_exchange(peer, others, round_id))
         walls = [w for w in await asyncio.gather(*exchanges)
                  if w is not None]
-        if overlap:
+        if ov_r:
             await acc_task
         if walls:
             round_wall = max(walls)
@@ -882,13 +987,13 @@ async def run_averaging_workload(swarm: SimSwarm,
             accum_wall = max(
                 _scaled_compute(p) * boundaries for p in alive
             )
-            hidden = min(round_wall, accum_wall) if overlap else 0.0
+            hidden = min(round_wall, accum_wall) if ov_r else 0.0
             exposed = round_wall - hidden
             ledger["hidden"] += hidden
             ledger["exposed"] += exposed
             alive[0].telemetry.event(
                 "opt.overlap_ledger", round_id=round_id,
-                mode="overlap" if overlap else "sync",
+                mode="overlap" if ov_r else "sync",
                 hidden_s=round(hidden, 6), exposed_s=round(exposed, 6),
                 efficiency=round(hidden / max(round_wall, 1e-9), 4),
             )
@@ -912,6 +1017,9 @@ async def run_averaging_workload(swarm: SimSwarm,
         "overlap": overlap,
         "groups_formed": groups_formed,
         "exchange_failures": exchange_failures,
+        # per-round topology mode actually run (the closed-loop scenario's
+        # re-plan timeline evidence; constant for plain workloads)
+        "round_modes": round_modes,
         # every formed group's size (unique nonce, singletons INCLUDED —
         # the flat-collapse signal is exactly the singleton flood)
         "groups_total": len(formed_sizes),
@@ -1188,6 +1296,291 @@ async def _scenario_watchdog(run: ScenarioRun) -> None:
     run.report["health_folds"] = folds
 
 
+# --------------------------------------------------- closed-loop scenario
+#
+# The ISSUE 16 acceptance scenario: detect -> re-plan -> retune -> recover,
+# with zero operator input, entirely in virtual time. The averaging
+# workload runs with scripted mid-run faults while a coordinator-shaped
+# controller runs after every round: health fold -> SwarmWatch -> the REAL
+# ``_Replanner`` (roles/coordinator.py) deriving epoch-versioned topology
+# plans from the fold's live link table -> the REAL ``ActuationGuard``
+# (telemetry/watch.py) applying scripted retune recommendations under the
+# guard rail and rolling harmful ones back. The workload re-reads its live
+# control dict each round, so an adopted plan (or chunk retune) reshapes
+# the NEXT round with no barrier — the runtime adoption contract. The DHT
+# wire machinery itself (publish/fetch backoff, fault ladder, mixed-epoch
+# scope isolation) is proven separately by the loopback tests in
+# tests/test_closed_loop.py against real DHT nodes.
+
+
+async def _scenario_closed_loop(run: ScenarioRun) -> None:
+    """Spec section ``control`` (all keys optional)::
+
+        control:
+          replan: true                # run the live replanner
+          replan_min_interval_s: 0.0  # epoch-bump hysteresis (virtual s)
+          adopt_delay_rounds: 0       # publish -> peer-adoption lag
+          settle_folds: 1             # ActuationConfig knobs...
+          observe_folds: 3
+          rollback_margin: 0.1
+          cooldown_folds: 1
+          max_actuations_per_epoch: 4
+          max_change_factor: 4.0
+          recommendations:            # scripted twin recommendations,
+            - at_fold: 8              # attached to the newest open
+              config: {chunk_size: 2048}   # incident from this fold on
+
+    Report adds ``replans`` (the epoch timeline), ``actuations`` (the
+    guard's full history incl. verdicts), ``sps_by_fold``, ``final_config``
+    and ``incident_rows`` — the coordinator-style incident JSONL rows,
+    dumped to ``incidents.jsonl`` for ``runlog_summary --incidents``."""
+    from dedloc_tpu.averaging.topology import TopologyPlan
+    from dedloc_tpu.roles.coordinator import _Replanner
+    from dedloc_tpu.telemetry.watch import (
+        ActuationConfig,
+        ActuationGuard,
+        SwarmWatch,
+        rollback_effect,
+    )
+
+    await phase_spawn(run)
+    run.report["link_overrides"] = apply_link_overrides(
+        run.network,
+        [p.host for p in run.swarm.peers],
+        run.spec.get("links"),
+    )
+    spec = run.spec
+    ctl = dict(spec.get("control") or {})
+
+    watch = SwarmWatch(_watch_config(spec))
+    fold_state: Dict[str, Any] = {}
+    folds: List[Dict[str, Any]] = []
+    transitions: List[Dict[str, Any]] = []
+    incident_rows: List[Dict[str, Any]] = []
+
+    def record_incident(t, step, transition, incident) -> None:
+        # the coordinator's incident-JSONL row shape (_append_incident):
+        # deep JSON copy, because the live dict keeps mutating
+        incident_rows.append({
+            "t": t, "step": step, "watch": "incident",
+            "transition": transition,
+            "incident": json.loads(json.dumps(incident, default=str)),
+        })
+
+    class _MemDHT:
+        """In-memory plan-record store: the replanner's publish target.
+        The wire itself (real DHT store/fetch, retries, fault points) is
+        proven by the loopback tests; here the records are evidence."""
+
+        def __init__(self):
+            self.stored: List[Any] = []
+
+        def store(self, key, value, expiration_time, subkey=None, **_kw):
+            self.stored.append({"key": key, "subkey": subkey,
+                                "value": value})
+            return True
+
+    replanner = None
+    if bool(ctl.get("replan", True)):
+        replanner = _Replanner(
+            _MemDHT(), str(spec.get("prefix", "twinexp")),
+            SimpleNamespace(replan_min_interval_s=float(
+                ctl.get("replan_min_interval_s", 0.0)
+            )),
+        )
+    guard = ActuationGuard(ActuationConfig(
+        max_change_factor=float(ctl.get("max_change_factor", 4.0)),
+        settle_folds=int(ctl.get("settle_folds", 1)),
+        observe_folds=int(ctl.get("observe_folds", 3)),
+        rollback_margin=float(ctl.get("rollback_margin", 0.1)),
+        cooldown_folds=int(ctl.get("cooldown_folds", 1)),
+        max_actuations_per_epoch=int(
+            ctl.get("max_actuations_per_epoch", 4)
+        ),
+    ))
+    # the actuated config in averager terms: chunk_size is ELEMENTS (fp32,
+    # 4 bytes each) exactly like --averager.chunk_size, mapped onto the
+    # workload's chunk_bytes on apply
+    current_config: Dict[str, Any] = {
+        "chunk_size": max(1024, int(spec.get("chunk_bytes", 24576))) // 4,
+        "overlap": bool(spec.get("overlap", False)),
+    }
+    scripted = [dict(rec) for rec in (ctl.get("recommendations") or [])]
+    adopt_delay = int(ctl.get("adopt_delay_rounds", 0))
+    pending_plans: List[Any] = []  # (adopt_at_round, label-keyed plan)
+    control: Dict[str, Any] = {}
+    label_by_endpoint = {
+        endpoint_key(p.endpoint): p.label for p in run.swarm.peers
+    }
+    replans: List[Dict[str, Any]] = []
+    actuation_events: List[Dict[str, Any]] = []
+    sps_by_fold: List[Optional[float]] = []
+
+    def _label_plan(plan: TopologyPlan) -> TopologyPlan:
+        """The replanner's plans key members by ENDPOINT (what runtime
+        matchmaking advertises); the sim workload matches by label —
+        re-key through the fold's own peers map."""
+        lp = TopologyPlan.from_dict(plan.to_dict())
+        lp.peers = sorted(
+            label_by_endpoint.get(p, p) for p in lp.peers
+        )
+        for c in lp.cliques:
+            c.members = sorted(
+                label_by_endpoint.get(m, m) for m in c.members
+            )
+            c.delegate = label_by_endpoint.get(c.delegate, c.delegate)
+        return lp
+
+    def _apply_config(delta: Dict[str, Any]) -> None:
+        current_config.update(delta)
+        control["chunk_bytes"] = max(
+            1024, int(current_config["chunk_size"]) * 4
+        )
+        control["overlap"] = bool(current_config.get("overlap", False))
+
+    async def on_round(r: int) -> None:
+        row = fold_swarm_health(run.swarm, r, fold_state)
+        folds.append(row)
+        health = row["swarm_health"]
+        if health is None:
+            return
+        t = row["time"]
+        # this fold's swarm throughput — the same sum the watch derives,
+        # and what the guard judges an in-flight actuation by
+        reported = [
+            float(p["samples_per_second"])
+            for p in health.get("peers", [])
+            if isinstance(p, dict)
+            and p.get("samples_per_second") is not None
+        ]
+        sps = sum(reported) if reported else None
+        sps_by_fold.append(sps)
+        for tr in watch.observe_health(
+            health, t=t, step=r, samples_per_sec=sps
+        ):
+            transitions.append({
+                "fold": watch.fold,
+                "transition": tr["transition"],
+                "incident": tr["incident"]["id"],
+                "kind": tr["incident"]["kind"],
+                "subject": tr["incident"]["subject"],
+            })
+            record_incident(t, r, tr["transition"], tr["incident"])
+
+        # ---- live re-planning off the fold (the production code path)
+        if replanner is not None:
+            published = replanner.fold(health, t)
+            if published is not None:
+                replans.append({
+                    "fold": watch.fold, "round": r,
+                    "epoch": int(published.epoch),
+                    "mode": published.mode,
+                    "reason": published.reason,
+                    "cliques": [sorted(c.members)
+                                for c in published.cliques],
+                })
+                pending_plans.append(
+                    (r + 1 + adopt_delay, _label_plan(published))
+                )
+        epoch = replanner.epoch if replanner is not None else 0
+
+        # ---- judge the in-flight actuation against this fold first
+        verdict = guard.observe(sps, fold=watch.fold)
+        if verdict is not None:
+            incident = next(
+                (i for i in watch.incidents
+                 if i["id"] == verdict.get("incident")), None,
+            )
+            if verdict["verdict"] == "rollback":
+                _apply_config(verdict["revert"])
+                if incident is not None:
+                    rollback_effect(incident, verdict)
+                    record_incident(t, r, "rollback", incident)
+            elif incident is not None:
+                for effect in incident.get("effects", []):
+                    if (
+                        effect.get("metric") == "actuation"
+                        and effect.get("applied") == verdict["applied"]
+                    ):
+                        effect["verdict"] = "kept"
+                record_incident(t, r, "actuation", incident)
+            actuation_events.append({
+                "fold": watch.fold, "round": r,
+                "verdict": verdict["verdict"],
+                "applied": dict(verdict["applied"]),
+            })
+
+        # ---- scripted recommendations: the twin fit, pre-computed by the
+        # spec (twin_recommendation itself is proven by twin_replay tests)
+        open_inc = watch.open_incidents()
+        for rec in scripted:
+            if rec.get("_attached") or watch.fold < int(
+                rec.get("at_fold", 0)
+            ):
+                continue
+            if not open_inc:
+                continue
+            target = open_inc[-1]
+            target["recommendation"] = {
+                "config": dict(rec.get("config") or {}),
+                "predicted_samples_per_sec": rec.get(
+                    "predicted_samples_per_sec"
+                ),
+            }
+            rec["_attached"] = True
+            record_incident(t, r, "recommendation", target)
+
+        # ---- apply at most one eligible recommendation under the rail
+        for incident in open_inc:
+            recommendation = incident.get("recommendation")
+            if not recommendation or incident.get("actuated"):
+                continue
+            result = guard.consider(
+                recommendation, current_config,
+                fold=watch.fold, epoch=epoch,
+            )
+            if "refused" in result:
+                incident["actuation_refused"] = result["refused"]
+                continue
+            incident.pop("actuation_refused", None)
+            _apply_config(result["apply"])
+            incident["actuated"] = True
+            guard.actuate(
+                incident, result["apply"], result["revert"],
+                fold=watch.fold, baseline_samples_per_sec=sps,
+                epoch=epoch, clamped=tuple(result["clamped"]),
+            )
+            actuation_events.append({
+                "fold": watch.fold, "round": r,
+                "verdict": "applied", "applied": dict(result["apply"]),
+            })
+            record_incident(t, r, "actuation", incident)
+            break  # one actuation per fold; the guard serializes the rest
+
+        # ---- adoption: plans whose publish->fetch lag expired reshape
+        # the NEXT round (peers poll between rounds; no barrier)
+        while pending_plans and pending_plans[0][0] <= r + 1:
+            _at, label_plan = pending_plans.pop(0)
+            control["plan"] = label_plan
+            control["enabled"] = True
+
+    run.report["averaging"] = await run_averaging_workload(
+        run.swarm, spec, on_round=on_round, control=control
+    )
+    run.report["watch"] = watch.summary()
+    run.report["transitions"] = transitions
+    run.report["health_folds"] = folds
+    run.report["replans"] = replans
+    run.report["plan_epoch"] = (
+        replanner.epoch if replanner is not None else 0
+    )
+    run.report["actuations"] = guard.history
+    run.report["actuation_events"] = actuation_events
+    run.report["sps_by_fold"] = sps_by_fold
+    run.report["final_config"] = dict(current_config)
+    run.report["incident_rows"] = incident_rows
+
+
 # -------------------------------------------------------------- scenarios
 
 
@@ -1289,6 +1682,7 @@ SCENARIOS: Dict[str, Callable] = {
     "averaging": _scenario_averaging,
     "hierarchical": _scenario_hierarchical,
     "watchdog": _scenario_watchdog,
+    "closed_loop": _scenario_closed_loop,
     # resolved specially by run_scenario: replays a fitted TwinModel
     # (dedloc_tpu/twin) instead of building a swarm from spec numbers
     "twin_replay": None,
@@ -1371,6 +1765,15 @@ def run_scenario(
                         for row in run.report["health_folds"]:
                             f.write(json.dumps(row) + "\n")
                     run.report["coordinator_log"] = path
+                if run.report.get("incident_rows"):
+                    # the coordinator's incident-JSONL shape (one row per
+                    # transition, last state per id wins) — what
+                    # runlog_summary --incidents and swarm_watch read
+                    path = os.path.join(out_dir, "incidents.jsonl")
+                    with open(path, "w", encoding="utf-8") as f:
+                        for row in run.report["incident_rows"]:
+                            f.write(json.dumps(row) + "\n")
+                    run.report["incident_log"] = path
     finally:
         run.engine.close()
     return run.report

@@ -26,6 +26,8 @@ AVG_ROUND = "avg.round"
 AVG_TOPOLOGY_FALLBACK = "avg.topology.fallback"
 AVG_TOPOLOGY_FALLBACKS = "avg.topology.fallbacks"
 AVG_TOPOLOGY_PLAN = "avg.topology.plan"
+AVG_TOPOLOGY_REPLAN = "avg.topology.replan"
+AVG_TOPOLOGY_REPLANS = "avg.topology.replans"
 AVG_TOPOLOGY_ROUND = "avg.topology.round"
 AVG_TOPOLOGY_ROUNDS = "avg.topology.rounds"
 CKPT_FETCH_FAILURES = "ckpt.fetch_failures"
@@ -88,6 +90,8 @@ OPT_OVERLAP_LEDGER = "opt.overlap_ledger"
 OPT_WEIGHT_DECISION = "opt.weight_decision"
 OPT_WEIGHT_SCALE = "opt.weight_scale"
 PEER_ENDPOINT = "peer.endpoint"
+PLAN_SYNC_RETRIES = "plan_sync.retries"
+PLAN_SYNC_RETRY = "plan_sync.retry"
 RPC_CLIENT_CALLS = "rpc.client.calls"
 RPC_CLIENT_FAILURE = "rpc.client.failure"
 RPC_CLIENT_FAILURES = "rpc.client.failures"
@@ -115,7 +119,11 @@ STEP_PHASE_FWD_BWD = "step.phase.fwd_bwd"
 STEP_RECORD = "step.record"
 STEP_SAMPLES_PER_SEC = "step.samples_per_sec"
 STEP_WALL = "step.wall"
+WATCH_ACTUATION = "watch.actuation"
+WATCH_ACTUATIONS = "watch.actuations"
 WATCH_INCIDENT = "watch.incident"
+WATCH_ROLLBACK = "watch.rollback"
+WATCH_ROLLBACKS = "watch.rollbacks"
 
 COUNTERS = frozenset({
     "allreduce.bytes_received",
@@ -127,6 +135,7 @@ COUNTERS = frozenset({
     "allreduce.stragglers",
     "avg.bytes_saved",
     "avg.topology.fallbacks",
+    "avg.topology.replans",
     "avg.topology.rounds",
     "ckpt.fetch_failures",
     "ckpt.fetch_retries",
@@ -162,6 +171,7 @@ COUNTERS = frozenset({
     "opt.overlap_failed",
     "opt.overlap_hidden_s",
     "opt.overlap_launched",
+    "plan_sync.retries",
     "rpc.client.calls",
     "rpc.client.failures",
     "rpc.client.remote_errors",
@@ -175,6 +185,8 @@ COUNTERS = frozenset({
     "state_sync.failures",
     "state_sync.ok",
     "state_sync.retries",
+    "watch.actuations",
+    "watch.rollbacks",
 })
 GAUGES = frozenset({
     "opt.ef_residual_norm",
@@ -206,6 +218,7 @@ EVENTS = frozenset({
     "avg.round",
     "avg.topology.fallback",
     "avg.topology.plan",
+    "avg.topology.replan",
     "avg.topology.round",
     "ckpt.manifest.serve",
     "ckpt.manifest_written",
@@ -232,6 +245,7 @@ EVENTS = frozenset({
     "opt.overlap_ledger",
     "opt.weight_decision",
     "peer.endpoint",
+    "plan_sync.retry",
     "rpc.client.failure",
     "rpc.conn_lost",
     "run.config",
@@ -242,7 +256,9 @@ EVENTS = frozenset({
     "state_sync.retry",
     "step.phase",
     "step.record",
+    "watch.actuation",
     "watch.incident",
+    "watch.rollback",
 })
 SPANS = frozenset({
     "allreduce.round",

@@ -45,9 +45,19 @@ Design:
   ``twin_recommendation`` then fits a TwinModel from the run's own logs
   (``twin/fit.py``), validates it against its own recording, runs a
   BOUNDED sweep and attaches the recommended config + predicted
-  samples/sec + fidelity-bounded interval — recommendation only, never
-  auto-applied. Runs with insufficient telemetry report
-  ``no_recommendation: <reason>`` instead of guessing.
+  samples/sec + fidelity-bounded interval. Runs with insufficient
+  telemetry report ``no_recommendation: <reason>`` instead of guessing.
+- **Guard-railed actuation.** A recommendation is no longer the end of the
+  loop: ``ActuationGuard`` (below) lets the coordinator (or the simulator's
+  closed-loop scenario) APPLY it under hard rails — per-actuation change
+  bound, one actuation under observation at a time, a per-plan-epoch
+  budget, and automatic rollback when the post-change throughput regresses
+  past the pre-change level. Every actuation and rollback lands on the
+  incident's ``effects`` list and as ``watch.actuation`` /
+  ``watch.rollback`` events, so ``runlog_summary --incidents`` /
+  ``swarm_watch`` audit exactly what the loop did. Operators opt out with
+  ``--coordinator.actuate_retune false`` (docs/fleet.md "closed-loop
+  operations").
 """
 from __future__ import annotations
 
@@ -973,7 +983,8 @@ def watch_rows(rows: List[Dict[str, Any]],
 
 
 # ---------------------------------------------------------------------------
-# Twin-backed retuning (ROADMAP item 4's closed loop), recommendation-only.
+# Twin-backed retuning (ROADMAP item 4's closed loop): recommendation fit +
+# the guard-railed actuation machinery that applies it (ISSUE 16).
 # ---------------------------------------------------------------------------
 
 # bounded by construction: the sweep the watchdog runs on an incident is a
@@ -1081,6 +1092,218 @@ def twin_recommendation(
         "configs_evaluated": len(results),
         "observed_samples_per_sec": model.observed.get("samples_per_sec"),
     }
+
+
+# actuation-eligible config keys (the twin sweep's grid keys — see the
+# default grid in twin_recommendation): anything else a recommendation
+# carries is reported but never applied
+ACTUATION_KEYS = ("chunk_size", "overlap")
+
+
+@dataclass
+class ActuationConfig:
+    """Guard-rail knobs for applying a twin recommendation (docs/fleet.md
+    "closed-loop operations"). Defaults are deliberately conservative: one
+    bounded change at a time, judged within a handful of folds."""
+
+    # numeric keys move at most this factor from the current value per
+    # actuation (a 64x chunk-size jump becomes two guarded 4x–16x steps)
+    max_change_factor: float = 4.0
+    # folds to let the change take effect before judging it
+    settle_folds: int = 1
+    # post-settle folds the change must survive to be kept
+    observe_folds: int = 3
+    # rollback when post-change samples/sec drops below
+    # (1 - rollback_margin) x the pre-change level
+    rollback_margin: float = 0.1
+    # folds between actuations (after a verdict, either way)
+    cooldown_folds: int = 4
+    # actuations per topology-plan epoch — a re-plan resets the budget
+    max_actuations_per_epoch: int = 2
+
+
+class ActuationGuard:
+    """The guard rail between a twin recommendation and the running swarm.
+
+    Pure computation like ``SwarmWatch`` — no clocks, no I/O, fold indices
+    come from the caller — so the coordinator's live loop and the
+    simulator's virtual-time closed-loop scenario share this one
+    implementation. Protocol: ``consider`` clamps a recommendation into an
+    applicable delta (or refuses with a reason), the caller applies it and
+    calls ``actuate`` (which records the incident effect), then feeds every
+    subsequent fold's swarm samples/sec into ``observe`` until a verdict —
+    ``"rollback"`` (the caller must re-apply ``record["revert"]`` and
+    append the rollback effect via ``rollback_effect``) or ``"kept"``."""
+
+    def __init__(self, config: Optional[ActuationConfig] = None) -> None:
+        self.cfg = config or ActuationConfig()
+        self.active: Optional[Dict[str, Any]] = None
+        self.history: List[Dict[str, Any]] = []
+        self._cooldown_until = -1
+        self._per_epoch: Dict[int, int] = {}
+
+    def consider(
+        self,
+        recommendation: Dict[str, Any],
+        current_config: Dict[str, Any],
+        *,
+        fold: int,
+        epoch: int = 0,
+    ) -> Dict[str, Any]:
+        """Clamp ``recommendation["config"]`` against the guard rails.
+        Returns ``{"apply": delta, "revert": previous, "clamped": keys}``
+        or ``{"refused": reason}`` — never raises."""
+        cfg = self.cfg
+        if self.active is not None:
+            return {"refused": (
+                f"actuation {self.active['applied']} from fold "
+                f"{self.active['fold']} is still under observation"
+            )}
+        if fold < self._cooldown_until:
+            return {"refused": (
+                f"in post-actuation cooldown until fold "
+                f"{self._cooldown_until}"
+            )}
+        if self._per_epoch.get(epoch, 0) >= cfg.max_actuations_per_epoch:
+            return {"refused": (
+                f"actuation budget exhausted for plan epoch {epoch} "
+                f"({cfg.max_actuations_per_epoch} per epoch)"
+            )}
+        config = recommendation.get("config") or {}
+        applied: Dict[str, Any] = {}
+        revert: Dict[str, Any] = {}
+        clamped: List[str] = []
+        for key in ACTUATION_KEYS:
+            if key not in config:
+                continue
+            want, cur = config[key], current_config.get(key)
+            if want == cur:
+                continue
+            if isinstance(want, bool) or isinstance(cur, bool):
+                applied[key] = bool(want)
+            elif (
+                isinstance(want, (int, float))
+                and isinstance(cur, (int, float))
+                and cur > 0
+            ):
+                bounded = min(
+                    max(float(want), cur / cfg.max_change_factor),
+                    cur * cfg.max_change_factor,
+                )
+                if isinstance(cur, int):
+                    bounded = int(round(bounded))
+                if bounded != want:
+                    clamped.append(key)
+                if bounded == cur:
+                    continue
+                applied[key] = bounded
+            else:
+                applied[key] = want
+            revert[key] = cur
+        if not applied:
+            return {"refused": (
+                "recommended config matches the current config "
+                "(nothing to apply within the guard rail)"
+            )}
+        return {"apply": applied, "revert": revert, "clamped": clamped}
+
+    def actuate(
+        self,
+        incident: Dict[str, Any],
+        applied: Dict[str, Any],
+        revert: Dict[str, Any],
+        *,
+        fold: int,
+        baseline_samples_per_sec: Optional[float],
+        epoch: int = 0,
+        clamped: Tuple[str, ...] = (),
+    ) -> Dict[str, Any]:
+        """Record a just-applied config delta and start observing it.
+        Appends the ``actuation`` effect to the incident and returns the
+        live actuation record (also kept in ``history``)."""
+        record: Dict[str, Any] = {
+            "incident": incident.get("id"),
+            "applied": dict(applied),
+            "revert": dict(revert),
+            "clamped": list(clamped),
+            "fold": fold,
+            "epoch": epoch,
+            "baseline_samples_per_sec": baseline_samples_per_sec,
+            "observed": [],
+            "verdict": "observing",
+        }
+        self.active = record
+        self.history.append(record)
+        self._per_epoch[epoch] = self._per_epoch.get(epoch, 0) + 1
+        verdict = "applied"
+        if clamped:
+            verdict += f" (guard-rail clamped: {', '.join(clamped)})"
+        incident.setdefault("effects", []).append({
+            "metric": "actuation",
+            "deviation": None,
+            "fold": fold,
+            "applied": dict(applied),
+            "verdict": verdict,
+        })
+        return record
+
+    def observe(self, samples_per_sec: Optional[float],
+                *, fold: int) -> Optional[Dict[str, Any]]:
+        """Judge the active actuation against one more fold's swarm
+        throughput. Returns the actuation record once a verdict lands
+        (``record["verdict"]`` is ``"rollback"`` or ``"kept"``), else
+        None. The pre-change level — NOT the pre-incident baseline — is
+        the rollback reference: the actuation exists because throughput
+        already regressed, so the rail only asks "did the change make it
+        WORSE", never "did it fix everything"."""
+        record = self.active
+        if record is None or samples_per_sec is None:
+            return None
+        if fold - record["fold"] < self.cfg.settle_folds:
+            return None
+        value = float(samples_per_sec)
+        record["observed"].append(round(value, 6))
+        baseline = record.get("baseline_samples_per_sec")
+        if (
+            baseline
+            and value < (1.0 - self.cfg.rollback_margin) * float(baseline)
+        ):
+            record["verdict"] = "rollback"
+            record["verdict_fold"] = fold
+            self.active = None
+            self._cooldown_until = fold + self.cfg.cooldown_folds
+            return record
+        if len(record["observed"]) >= self.cfg.observe_folds:
+            record["verdict"] = "kept"
+            record["verdict_fold"] = fold
+            self.active = None
+            self._cooldown_until = fold + self.cfg.cooldown_folds
+            return record
+        return None
+
+
+def rollback_effect(incident: Dict[str, Any],
+                    record: Dict[str, Any]) -> Dict[str, Any]:
+    """Append (and return) the ``rollback`` effect for a rolled-back
+    actuation — the caller re-applies ``record["revert"]`` itself and then
+    records the fact here, so the incident chain reads
+    actuation → rollback in ``runlog_summary --incidents``."""
+    baseline = record.get("baseline_samples_per_sec")
+    observed = record["observed"][-1] if record.get("observed") else None
+    deviation = None
+    if baseline and observed is not None:
+        deviation = round(float(observed) / float(baseline) - 1.0, 4)
+    effect = {
+        "metric": "rollback",
+        "deviation": deviation,
+        "fold": record.get("verdict_fold", record["fold"]),
+        "applied": dict(record.get("revert") or {}),
+        "verdict": (
+            "post-change samples/sec regressed past the pre-change level"
+        ),
+    }
+    incident.setdefault("effects", []).append(effect)
+    return effect
 
 
 def attach_recommendation(

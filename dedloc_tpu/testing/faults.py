@@ -24,6 +24,8 @@ Fault points currently wired:
 | ``averager.state_get`` | state-snapshot reply (blob mutation)    | size |
 | ``checkpoint.shard_get`` | sharded-checkpoint shard reply (bytes mutation) | index, size |
 | ``fleet.preempt``      | ``LocalFleet`` victim selection         | alive |
+| ``averager.hier_wan``  | delegate's WAN leg of a hierarchical round | round_id, delegate |
+| ``topology.plan_record`` | plan publish/fetch (``averaging/planwire.py``; ``drop`` = record lost in flight, others raise) | op, epoch (publish only) |
 
 Actions: ``drop`` (reset the connection / raise ConnectionResetError —
 process-death semantics: a killed peer's OS resets its sockets), ``delay``

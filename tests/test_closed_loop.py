@@ -1,0 +1,822 @@
+"""The closed adaptation loop (ISSUE 16): coordinator-driven live topology
+re-planning, guard-railed retune actuation, and the gossip fallback.
+
+Acceptance (all virtual-time, deterministic): a scripted cross-site link
+degrade (route-flap flavor, so reconnects re-sample the new RTT) plus a
+mild churn wave triggers a coordinator re-plan into hierarchical mode AND a
+guard-railed retune, and the swarm recovers >= 80% of its pre-fault
+samples/sec within a bounded number of rounds with zero operator input; a
+scripted HARMFUL actuation is automatically rolled back; both are visible
+as incident effects via ``runlog_summary --incidents``. A churn wave heavy
+enough to cross ``GOSSIP_INSTABILITY_THRESHOLD`` re-plans into gossip
+neighbor averaging. Rollout safety: plan epochs version every matchmaking
+scope, so mixed-epoch peers form disjoint groups (proven over loopback with
+real DHT + averagers); plan publish/fetch retry transient DHT failures with
+bounded exponential backoff; an unparseable plan record degrades the
+follower to flat with a named reason after the consecutive-failure budget.
+"""
+import copy
+import importlib.util
+import json
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dedloc_tpu.averaging.planwire import (
+    MAX_PLAN_FETCH_FAILURES,
+    PlanRecord,
+    fetch_plan,
+    parse_plan_entries,
+    plan_key,
+    publish_plan,
+)
+from dedloc_tpu.averaging.topology import (
+    GOSSIP_INSTABILITY_THRESHOLD,
+    CliquePlan,
+    TopologyPlan,
+    plan_topology,
+)
+from dedloc_tpu.core.timeutils import get_dht_time
+from dedloc_tpu.simulator.scenarios import run_scenario
+from dedloc_tpu.telemetry.watch import (
+    ActuationConfig,
+    ActuationGuard,
+    rollback_effect,
+)
+
+pytestmark = pytest.mark.simulator
+
+_TOOLS = Path(__file__).resolve().parent.parent / "tools"
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(name, _TOOLS / f"{name}.py")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+# order matters: swarm_watch resolves `runlog_summary` via sys.modules
+runlog_summary = _load_tool("runlog_summary")
+import sys  # noqa: E402
+
+sys.modules.setdefault("runlog_summary", runlog_summary)
+swarm_watch = _load_tool("swarm_watch")
+
+
+# --------------------------------------------------------------- scenarios
+# One cross-site swarm: two 6-peer sites over fast local links; at ONSET
+# the inter-site links flap to 30ms / 8 Mb/s WAN (reset_connections so the
+# piggybacked connect-time ping re-samples the new RTT — without the flap
+# the pooled connections, and therefore the re-planner's clique detector,
+# would stay blind to the change, exactly as in production).
+
+N, ONSET = 12, 4
+
+_CROSS_DEGRADE = [
+    {"kind": "link", "at_round": ONSET, "src": f"peer-{s:04d}",
+     "dst": f"peer-{d:04d}", "latency_s": 0.03, "bandwidth_bps": 8e6,
+     "reset_connections": True}
+    for i in range(N // 2) for j in range(N // 2, N)
+    for s, d in ((i, j), (j, i))
+]
+
+RECOVERY_SPEC = {
+    "scenario": "closed_loop", "peers": N, "seed": 3,
+    "link": {"latency_s": 0.004, "bandwidth_bps": 2e8},
+    "avg_rounds": 14, "group_size": N,
+    "span_bytes": 262144, "chunk_bytes": 16384,
+    "boundaries": 2, "compute_s": 0.4, "window_s": 2.0,
+    # the degrade plus a mild churn wave (1/12 per fold — well under the
+    # gossip threshold, so the planner still picks hierarchical)
+    "faults": _CROSS_DEGRADE + [
+        {"kind": "churn", "at_round": ONSET + 1, "count": 1},
+    ],
+    "control": {
+        "replan": True, "replan_min_interval_s": 120.0,
+        "settle_folds": 1, "observe_folds": 3,
+        "cooldown_folds": 2, "max_actuations_per_epoch": 4,
+        # the scripted twin recommendation (the fit itself is proven by
+        # the twin suite; pinning WHAT gets recommended keeps the
+        # guard-rail path deterministic): larger WAN chunks + overlap to
+        # hide the accumulate under the now-slower exchange
+        "recommendations": [
+            {"at_fold": 7,
+             "config": {"chunk_size": 16384, "overlap": True},
+             "predicted_samples_per_sec": None},
+        ],
+    },
+}
+
+ROLLBACK_SPEC = {
+    "scenario": "closed_loop", "peers": 8, "seed": 3,
+    "link": {"latency_s": 0.004, "bandwidth_bps": 2e8},
+    "avg_rounds": 11, "group_size": 8,
+    "span_bytes": 262144, "chunk_bytes": 16384,
+    "boundaries": 1, "compute_s": 0.05, "window_s": 2.0,
+    # same cross-site degrade shape (4+4) to open a link incident, but NO
+    # re-planning: the scenario under test is the guard rail alone
+    "faults": [
+        {"kind": "link", "at_round": 3, "src": f"peer-{s:04d}",
+         "dst": f"peer-{d:04d}", "latency_s": 0.03, "bandwidth_bps": 8e6,
+         "reset_connections": True}
+        for i in range(4) for j in range(4, 8)
+        for s, d in ((i, j), (j, i))
+    ],
+    "control": {
+        "replan": False,
+        "settle_folds": 1, "observe_folds": 3, "rollback_margin": 0.1,
+        "cooldown_folds": 2, "max_actuations_per_epoch": 4,
+        # a HARMFUL scripted recommendation: shrinking the chunks
+        # quadruples the per-chunk WAN latency bill
+        "recommendations": [
+            {"at_fold": 5, "config": {"chunk_size": 1024},
+             "predicted_samples_per_sec": None},
+        ],
+    },
+}
+
+GOSSIP_SPEC = {
+    "scenario": "closed_loop", "peers": 12, "seed": 3,
+    "link": {"latency_s": 0.004, "bandwidth_bps": 2e8},
+    "avg_rounds": 8, "group_size": 12,
+    "span_bytes": 65536, "chunk_bytes": 16384,
+    "boundaries": 1, "compute_s": 0.05, "window_s": 2.0,
+    # a churn WAVE: 4 then 4 of 12 — the 4-fold loss window's mean crosses
+    # GOSSIP_INSTABILITY_THRESHOLD, so the planner's third interpolation
+    # point engages
+    "faults": [
+        {"kind": "churn", "at_round": 2, "count": 4},
+        {"kind": "churn", "at_round": 3, "count": 4},
+    ],
+    "control": {"replan": True, "replan_min_interval_s": 60.0,
+                "recommendations": []},
+}
+
+
+@pytest.fixture(scope="module")
+def recovery_run(tmp_path_factory):
+    out = tmp_path_factory.mktemp("closed_loop_recovery")
+    return run_scenario(copy.deepcopy(RECOVERY_SPEC), out_dir=str(out))
+
+
+@pytest.fixture(scope="module")
+def rollback_run(tmp_path_factory):
+    out = tmp_path_factory.mktemp("closed_loop_rollback")
+    return run_scenario(copy.deepcopy(ROLLBACK_SPEC), out_dir=str(out))
+
+
+@pytest.fixture(scope="module")
+def gossip_run(tmp_path_factory):
+    return run_scenario(copy.deepcopy(GOSSIP_SPEC))
+
+
+def _pre_fault_sps(report):
+    return max(s for s in report["sps_by_fold"][1:ONSET] if s)
+
+
+# ------------------------------------------------------------- acceptance
+
+
+def test_recovery_replan_fires_and_adopts(recovery_run):
+    """The degrade is detected FROM THE FOLD (the same link table the
+    --topology view renders): exactly one re-plan, hierarchical, two
+    6-peer site cliques, published the fold the flapped RTTs land and
+    adopted by the whole swarm the round after."""
+    replans = recovery_run["replans"]
+    assert len(replans) == 1, replans
+    plan = replans[0]
+    assert plan["epoch"] == 1 and plan["mode"] == "hierarchical"
+    assert plan["fold"] == ONSET  # detection: the very fold of the flap
+    # clique members are endpoint-keyed ("label:port") as on a real fold
+    sites = sorted(sorted(m.split(":")[0] for m in c)
+                   for c in plan["cliques"])
+    assert sites == [
+        [f"peer-{i:04d}" for i in range(6)],
+        [f"peer-{i:04d}" for i in range(6, 12)],
+    ]
+    modes = recovery_run["averaging"]["round_modes"]
+    assert set(modes[:ONSET + 1]) == {"flat"}
+    # adoption between rounds, no barrier: every round after the publish
+    # runs the two-level plan
+    assert set(modes[ONSET + 1:]) == {"hierarchical"}
+    assert recovery_run["plan_epoch"] == 1
+
+
+def test_recovery_retune_applied_and_kept(recovery_run):
+    """The scripted twin recommendation is actuated under the guard rail
+    (no clamp needed: 4096 -> 16384 elements is exactly the default
+    max_change_factor) and KEPT after the observation folds."""
+    events = recovery_run["actuation_events"]
+    assert [e["verdict"] for e in events] == ["applied", "kept"]
+    assert events[0]["applied"] == {"chunk_size": 16384, "overlap": True}
+    (record,) = recovery_run["actuations"]
+    assert record["verdict"] == "kept" and record["clamped"] == []
+    assert recovery_run["final_config"] == {
+        "chunk_size": 16384, "overlap": True,
+    }
+
+
+def test_recovery_throughput_bar(recovery_run):
+    """THE acceptance bar: >= 80% of pre-fault samples/sec back within a
+    bounded number of rounds, zero operator input, zero failed exchanges."""
+    sps = recovery_run["sps_by_fold"]
+    pre = _pre_fault_sps(recovery_run)
+    dip = min(s for s in sps[ONSET:] if s)
+    assert dip < 0.7 * pre, "the fault must actually hurt"
+    recovered = [i for i, s in enumerate(sps) if i >= ONSET and s
+                 and s >= 0.8 * pre]
+    assert recovered, f"never recovered: {[round(s, 1) for s in sps]}"
+    assert recovered[0] - ONSET <= 6, "recovery not within bounded rounds"
+    for s in sps[-4:]:
+        assert s >= 0.8 * pre, "recovery did not HOLD"
+    assert recovery_run["averaging"]["exchange_failures"] == 0
+    assert recovery_run["averaging"]["singleton_groups"] == 0
+
+
+def test_recovery_incident_log_renders_actuation(recovery_run):
+    """The dumped incidents.jsonl replays through runlog_summary
+    --incidents (recorded branch): the actuation effect renders with the
+    applied config delta and the guard-rail verdict."""
+    path = recovery_run.get("incident_log")
+    assert path and Path(path).exists()
+    rows = [json.loads(line) for line in Path(path).read_text().splitlines()]
+    assert any(r["transition"] == "actuation" for r in rows)
+    doc = runlog_summary.incidents_data(rows)
+    assert doc["source"] == "recorded"
+    rendered = "\n".join(
+        swarm_watch.format_incident(inc) for inc in doc["incidents"]
+    )
+    assert "actuation@fold7" in rendered
+    assert '"chunk_size": 16384' in rendered
+    assert "[applied]" in rendered or "[kept]" in rendered
+
+
+def test_rollback_scenario_auto_reverts(rollback_run):
+    """A scripted HARMFUL actuation (chunk shrink on a latency-priced WAN)
+    regresses throughput past the guard's margin and is rolled back
+    automatically; the config is restored and throughput returns to the
+    pre-actuation level."""
+    events = rollback_run["actuation_events"]
+    assert [e["verdict"] for e in events] == ["applied", "rollback"]
+    (record,) = rollback_run["actuations"]
+    assert record["verdict"] == "rollback"
+    # the harmful recommendation was clamped on the way in (1024 is past
+    # the 4x rail from 4096) and fully reverted on the way out
+    assert record["revert"] == {"chunk_size": 4096}
+    assert rollback_run["final_config"]["chunk_size"] == 4096
+    sps = rollback_run["sps_by_fold"]
+    applied_fold = events[0]["fold"]
+    before = sps[applied_fold - 1]
+    harmed = min(s for s in sps[applied_fold:applied_fold + 2] if s)
+    assert harmed < 0.9 * before, "the bad actuation must actually hurt"
+    assert sps[-1] >= 0.9 * before, "rollback did not restore throughput"
+
+
+def test_rollback_chain_visible_in_incident_effects(rollback_run):
+    """Both transitions chain onto the CAUSING incident as effects —
+    auditable via runlog_summary --incidents and swarm_watch (--brief
+    included)."""
+    path = rollback_run.get("incident_log")
+    rows = [json.loads(line) for line in Path(path).read_text().splitlines()]
+    assert [r["transition"] for r in rows if r["transition"] in
+            ("actuation", "rollback")] == ["actuation", "rollback"]
+    doc = runlog_summary.incidents_data(rows)
+    chained = [
+        inc for inc in doc["incidents"]
+        if [e["metric"] for e in inc.get("effects", [])
+            if e["metric"] in ("actuation", "rollback")]
+        == ["actuation", "rollback"]
+    ]
+    assert chained, "no incident carries the actuation -> rollback chain"
+    effects_line = swarm_watch.format_effects(chained[0])
+    assert "rollback@fold" in effects_line
+    assert '"chunk_size": 4096' in effects_line  # the applied REVERT delta
+    assert "regressed past the pre-change level" in effects_line
+
+
+def test_swarm_watch_recorded_branch_renders_incident_log(rollback_run):
+    """``swarm_watch [--brief]`` pointed at the coordinator's incident
+    JSONL (no health rows to replay) renders the RECORDED incidents — the
+    only place actuation/rollback effects live."""
+    path = rollback_run.get("incident_log")
+    rows = [json.loads(line) for line in Path(path).read_text().splitlines()]
+    summary = swarm_watch.recorded_summary(rows)
+    assert summary is not None
+    assert summary["verdict"]["status"] == "recorded"
+    assert summary["open"] == len(summary["incidents"]) > 0
+    rendered = "\n".join(
+        swarm_watch.format_incident(i) for i in summary["incidents"]
+    )
+    assert "actuation@fold" in rendered and "rollback@fold" in rendered
+    # health rows are not recorded incidents: the branch must decline
+    assert swarm_watch.recorded_summary([{"swarm_health": {}}]) is None
+
+
+def test_gossip_replan_on_heavy_churn(gossip_run):
+    """A churn wave past GOSSIP_INSTABILITY_THRESHOLD re-plans the swarm
+    into gossip neighbor averaging: deterministic per-round pairs, adopted
+    between rounds, and the survivors' throughput recovers from the
+    full-swarm formation stalls the dead peers were causing."""
+    replans = gossip_run["replans"]
+    assert len(replans) == 1, replans
+    assert replans[0]["mode"] == "gossip"
+    assert "instability" in replans[0]["reason"]
+    modes = gossip_run["averaging"]["round_modes"]
+    assert modes[-1] == "gossip" and "gossip" in modes
+    first_gossip = modes.index("gossip")
+    assert set(modes[first_gossip:]) == {"gossip"}
+    sps = gossip_run["sps_by_fold"]
+    # flat full-swarm rounds over the churned roster idle out the window;
+    # gossip pairs of survivors beat that floor
+    assert max(sps[first_gossip:]) > min(
+        s for s in sps[2:first_gossip] if s
+    )
+    assert gossip_run["averaging"]["exchange_failures"] == 0
+
+
+# ------------------------------------------------- epoch scopes + pairing
+
+
+def test_epoch_scopes_disjoint_and_epoch0_byte_identical():
+    clique = CliquePlan(members=["a", "b"], delegate="a")
+    legacy = TopologyPlan("hierarchical", "t", cliques=[clique])
+    e1 = TopologyPlan("hierarchical", "t", cliques=[clique], epoch=1)
+    e2 = TopologyPlan("hierarchical", "t", cliques=[clique], epoch=2)
+    # epoch 0 keeps the historical scope strings BYTE-IDENTICAL (file-pinned
+    # plans and pre-epoch peers interoperate unchanged)
+    assert legacy.clique_scope(clique) == f"clique:{clique.key()}"
+    assert legacy.wan_scope() == "wan"
+    # every epoch pair is pairwise-disjoint across every scope kind
+    scopes = [
+        (p.clique_scope(clique), p.wan_scope(), p.gossip_scope(["a", "b"]))
+        for p in (legacy, e1, e2)
+    ]
+    for kind in range(3):
+        values = [s[kind] for s in scopes]
+        assert len(set(values)) == 3, values
+    assert e1.clique_scope(clique).startswith("clique:e1:")
+    assert e1.wan_scope() == "wan:e1"
+    # round-trip preserves the epoch (the wire record path)
+    assert TopologyPlan.from_dict(e2.to_dict()).epoch == 2
+
+
+def test_gossip_groups_deterministic_rotating_odd_roster():
+    peers = [f"p{i}" for i in range(7)]
+    plan = TopologyPlan("gossip", "t", peers=peers, epoch=3)
+    twin = TopologyPlan.from_dict(plan.to_dict())
+    a = plan.gossip_groups("avground-0005")
+    # same plan + round id => identical pairing on every peer, no messages
+    assert a == twin.gossip_groups("avground-0005")
+    # odd roster: nobody averages alone — the remainder merges into the
+    # last group
+    assert sorted(len(g) for g in a) == [2, 2, 3]
+    assert sorted(m for g in a for m in g) == sorted(peers)
+    # pairs rotate across rounds (the mixing argument)
+    rounds = [tuple(map(tuple, plan.gossip_groups(f"r{i}")))
+              for i in range(6)]
+    assert len(set(rounds)) > 1
+    # membership lookup agrees with the grouping; unknown ids are None
+    for g in a:
+        for m in g:
+            assert plan.gossip_group_of([m], "avground-0005") == g
+    assert plan.gossip_group_of(["ghost"], "avground-0005") is None
+
+
+def test_planner_gossip_selection_by_instability():
+    links = [
+        {"src": s, "dst": d, "rtt_s": 0.02, "goodput_bps": 1e8}
+        for s in ("a", "b", "c") for d in ("a", "b", "c") if s != d
+    ]
+    below = plan_topology(links, instability=0.1)
+    assert below.mode != "gossip"
+    at = plan_topology(links, instability=GOSSIP_INSTABILITY_THRESHOLD)
+    assert at.mode == "gossip" and sorted(at.peers) == ["a", "b", "c"]
+    # gossip needs someone to gossip WITH: a 2-peer swarm stays put
+    two = [link for link in links
+           if "c" not in (link["src"], link["dst"])]
+    assert plan_topology(two, instability=0.9).mode != "gossip"
+
+
+# ----------------------------------------------------------- guard rail
+
+
+def test_guard_clamps_refuses_and_budgets():
+    guard = ActuationGuard(ActuationConfig(
+        max_change_factor=4.0, settle_folds=1, observe_folds=2,
+        cooldown_folds=3, max_actuations_per_epoch=1,
+    ))
+    cfg = {"chunk_size": 4096, "overlap": False}
+    # a 64x jump is clamped to the 4x rail; the bool rides along
+    result = guard.consider(
+        {"config": {"chunk_size": 262144, "overlap": True}}, cfg, fold=5,
+    )
+    assert result["apply"] == {"chunk_size": 16384, "overlap": True}
+    assert result["revert"] == {"chunk_size": 4096, "overlap": False}
+    assert result["clamped"] == ["chunk_size"]
+    guard.actuate({"id": "inc-1"}, result["apply"], result["revert"],
+                  fold=5, baseline_samples_per_sec=100.0, epoch=1,
+                  clamped=tuple(result["clamped"]))
+    # one actuation under observation at a time
+    refused = guard.consider({"config": {"chunk_size": 8192}}, cfg, fold=6)
+    assert "under observation" in refused["refused"]
+    # survive the observation window -> kept; then the cooldown refuses
+    assert guard.observe(99.0, fold=6) is None  # first of two observations
+    verdict = guard.observe(99.0, fold=7)
+    assert verdict is not None and verdict["verdict"] == "kept"
+    refused = guard.consider(
+        {"config": {"chunk_size": 8192}}, cfg, fold=9, epoch=1,
+    )
+    assert "cooldown" in refused["refused"]
+    # past the cooldown, epoch 1's budget (1) is spent; epoch 2 resets it
+    refused = guard.consider(
+        {"config": {"chunk_size": 8192}}, cfg, fold=20, epoch=1,
+    )
+    assert "budget exhausted" in refused["refused"]
+    ok = guard.consider(
+        {"config": {"chunk_size": 8192}}, cfg, fold=20, epoch=2,
+    )
+    assert ok["apply"] == {"chunk_size": 8192}
+    # a no-op recommendation is refused, not silently "applied"
+    noop = guard.consider({"config": {"chunk_size": 4096}}, cfg, fold=30,
+                          epoch=2)
+    assert "refused" in noop
+
+
+def test_guard_rollback_verdict_and_effect_chain():
+    guard = ActuationGuard(ActuationConfig(
+        settle_folds=1, observe_folds=3, rollback_margin=0.1,
+    ))
+    incident = {"id": "inc-2"}
+    record = guard.actuate(
+        incident, {"chunk_size": 1024}, {"chunk_size": 4096},
+        fold=10, baseline_samples_per_sec=50.0,
+    )
+    assert incident["effects"][0]["metric"] == "actuation"
+    assert incident["effects"][0]["applied"] == {"chunk_size": 1024}
+    assert guard.observe(48.0, fold=10) is None  # still settling
+    assert guard.observe(46.0, fold=11) is None  # within the 10% margin
+    verdict = guard.observe(40.0, fold=12)  # 20% under: rolled back
+    assert verdict is record and verdict["verdict"] == "rollback"
+    effect = rollback_effect(incident, record)
+    assert [e["metric"] for e in incident["effects"]] == [
+        "actuation", "rollback",
+    ]
+    # the rollback effect's applied delta is the REVERT (what the caller
+    # re-applies), with the measured regression attached
+    assert effect["applied"] == {"chunk_size": 4096}
+    assert effect["deviation"] == pytest.approx(-0.2)
+
+
+# ------------------------------------------------------------- plan wire
+
+
+def _plan_record(epoch=1, mode="hierarchical", **kw):
+    if mode == "hierarchical":
+        plan = TopologyPlan(
+            mode, "t", cliques=[CliquePlan(["a", "b"], "a")], epoch=epoch,
+        )
+    else:
+        plan = TopologyPlan(mode, "t", peers=["a", "b", "c"], epoch=epoch)
+    return PlanRecord(epoch=epoch, plan=plan.to_dict(),
+                      issued=get_dht_time(), **kw)
+
+
+def test_plan_record_schema_rejects_malformed():
+    good = _plan_record(tuning={"chunk_size": 65536, "overlap": True})
+    assert PlanRecord.model_validate(good.model_dump()).epoch == 1
+    base = good.model_dump()
+    bad = [
+        dict(base, epoch=-1),
+        dict(base, plan=dict(base["plan"], mode="ring")),
+        dict(base, plan=dict(base["plan"], cliques=[])),
+        dict(base, plan=dict(base["plan"], epoch=7)),  # epoch mismatch
+        dict(base, tuning={"chunk_size": [1, 2]}),  # non-scalar tuning
+    ]
+    for payload in bad:
+        with pytest.raises(Exception):
+            PlanRecord.model_validate(payload)
+    with pytest.raises(Exception):  # gossip with a 1-peer roster
+        PlanRecord(
+            epoch=1, issued=0.0,
+            plan=TopologyPlan("gossip", "t", peers=["a"], epoch=1).to_dict(),
+        )
+
+
+def test_parse_plan_entries_highest_epoch_and_named_reason():
+    e1, e3 = _plan_record(1), _plan_record(3)
+    best, reason = parse_plan_entries([
+        (b"a", e1.model_dump()),
+        (b"b", {"epoch": "junk"}),
+        (b"c", e3.model_dump()),
+    ])
+    assert best is not None and best.epoch == 3 and reason == ""
+    none, reason = parse_plan_entries([(b"a", {"not": "a plan"})])
+    assert none is None and "unparseable plan record" in reason
+
+
+class _FlakyDHT:
+    """store/get fail `fail` times, then succeed — the transient-blip shape
+    the bounded backoff exists for."""
+
+    def __init__(self, fail=0):
+        self.fail = fail
+        self.calls = 0
+        self.stored = []
+
+    def store(self, key, value, expiration, subkey=None):
+        self.calls += 1
+        if self.calls <= self.fail:
+            raise OSError("transient DHT blip")
+        self.stored.append((key, subkey, value))
+        return True
+
+    def get(self, key, latest=False):
+        self.calls += 1
+        if self.calls <= self.fail:
+            raise OSError("transient DHT blip")
+        if not self.stored:
+            return None
+        value = {
+            sk: type("V", (), {"value": v})()
+            for _, sk, v in self.stored
+        }
+        return type("E", (), {"value": value})()
+
+
+def test_publish_and_fetch_retry_transient_failures():
+    record = _plan_record(2)
+    dht = _FlakyDHT(fail=2)
+    # two blips fit inside the retry budget (attempt + 2 retries)
+    assert publish_plan(dht, "exp", record, backoff=0.0) is True
+    assert dht.stored and dht.stored[0][0] == plan_key("exp")
+    flaky = _FlakyDHT(fail=2)
+    flaky.stored = list(dht.stored)
+    got, reason = fetch_plan(flaky, "exp", backoff=0.0)
+    assert got is not None and got.epoch == 2 and reason == ""
+    # a blip PAST the budget is a named failure, never a crash
+    dead = _FlakyDHT(fail=99)
+    assert publish_plan(dead, "exp", record, backoff=0.0) is False
+    got, reason = fetch_plan(dead, "exp", backoff=0.0)
+    assert got is None and "plan fetch failed" in reason
+
+
+def test_plan_record_fault_point_drops_records():
+    from dedloc_tpu.testing.faults import FaultSchedule
+
+    record = _plan_record(1)
+    with FaultSchedule() as sched:
+        sched.inject("topology.plan_record", "drop", times=-1,
+                     match=lambda ctx: ctx["op"] == "publish")
+        dht = _FlakyDHT()
+        assert publish_plan(dht, "exp", record, backoff=0.0) is False
+        assert dht.stored == []  # every attempt lost in flight
+        assert sched.fired
+    dht = _FlakyDHT()
+    assert publish_plan(dht, "exp", record, backoff=0.0) is True
+    with FaultSchedule() as sched:
+        sched.inject("topology.plan_record", "drop", times=-1,
+                     match=lambda ctx: ctx["op"] == "fetch")
+        got, reason = fetch_plan(dht, "exp", backoff=0.0)
+        assert got is None and "plan record lost" in reason
+
+
+# ------------------------------------------------- follower failure ladder
+
+
+def test_unparseable_record_degrades_follower_to_flat():
+    """Satellite (c): a garbage plan record (stored on a validator-less
+    test DHT; production storing nodes reject it at the schema boundary)
+    must not crash the follower — it keeps its current plan through the
+    consecutive-failure budget, then degrades to flat with a named reason,
+    and re-adopts once a valid record reappears."""
+    from dedloc_tpu.averaging import DecentralizedAverager
+    from dedloc_tpu.dht import DHT
+
+    dht = DHT(start=True, listen_host="127.0.0.1")
+    try:
+        avg = DecentralizedAverager(
+            dht, "badplan", listen_host="127.0.0.1", plan_follow=True,
+            plan_refresh_period=0.0,
+        )
+        try:
+            held = TopologyPlan(
+                "hierarchical", "t",
+                cliques=[CliquePlan(["a", "b"], "a")], epoch=1,
+            )
+            avg.set_topology_plan(held)
+            avg._plan_epoch = 1
+            dht.store(
+                plan_key("badplan"), {"mode": "ring"},
+                get_dht_time() + 60, subkey=b"coordinator",
+            )
+            _, reason = fetch_plan(dht, "badplan", backoff=0.0)
+            assert "unparseable plan record" in reason
+            for i in range(MAX_PLAN_FETCH_FAILURES):
+                assert avg._topology_plan is not None, f"degraded at {i}"
+                avg._plan_next_refresh = 0.0
+                avg.maybe_refresh_plan()
+            assert avg._topology_plan is None  # flat, by the named ladder
+            # a recovered coordinator re-publishes a VALID record: the
+            # follower re-adopts it (the watermark was reset on degrade)
+            publish_plan(dht, "badplan", _plan_record(1), backoff=0.0)
+            avg._plan_next_refresh = 0.0
+            avg.maybe_refresh_plan()
+            assert avg._topology_plan is not None
+            assert avg._plan_epoch == 1
+        finally:
+            avg.shutdown()
+    finally:
+        dht.shutdown()
+
+
+def test_tuning_only_republish_adopts_without_scope_reshuffle():
+    """Same epoch, newer ``issued``: the actuated retune's distribution
+    channel — chunk geometry updates, the plan object's scopes do not."""
+    from dedloc_tpu.averaging import DecentralizedAverager
+    from dedloc_tpu.dht import DHT
+
+    dht = DHT(start=True, listen_host="127.0.0.1")
+    try:
+        avg = DecentralizedAverager(
+            dht, "tun", listen_host="127.0.0.1", plan_follow=True,
+            plan_refresh_period=0.0,
+        )
+        try:
+            publish_plan(dht, "tun", _plan_record(1), backoff=0.0)
+            avg.maybe_refresh_plan()
+            assert avg._plan_epoch == 1
+            plan_obj = avg._topology_plan
+            before_chunk = avg.chunk_size
+            newer = PlanRecord(
+                epoch=1, plan=_plan_record(1).plan,
+                issued=get_dht_time() + 5.0,
+                tuning={"chunk_size": before_chunk * 2, "overlap": True},
+            )
+            publish_plan(dht, "tun", newer, backoff=0.0)
+            avg._plan_next_refresh = 0.0
+            avg.maybe_refresh_plan()
+            assert avg.chunk_size == before_chunk * 2
+            assert avg.plan_tuning == {
+                "chunk_size": before_chunk * 2, "overlap": True,
+            }
+            # tuning-only: the plan OBJECT was not replaced (no reshuffle)
+            assert avg._topology_plan is plan_obj
+            # an OLDER republish (stale coordinator replica) is ignored
+            avg._plan_next_refresh = 0.0
+            publish_plan(
+                dht, "tun",
+                PlanRecord(epoch=1, plan=_plan_record(1).plan, issued=0.0),
+                subkey=b"stale", backoff=0.0,
+            )
+            avg.maybe_refresh_plan()
+            assert avg.chunk_size == before_chunk * 2
+        finally:
+            avg.shutdown()
+    finally:
+        dht.shutdown()
+
+
+# --------------------------------------------------- mixed-epoch loopback
+
+
+def test_mixed_epoch_rollout_forms_disjoint_groups(rng):
+    """Satellite (c) over REAL loopback DHT + averagers: two 2-peer
+    cliques hold structurally-identical plans on epochs 1 and 2 (the
+    mid-rollout state where one clique has not fetched the re-plan yet).
+    Epoch-qualified scopes keep every group disjoint — each clique
+    averages exactly its own members' contributions (the delegates'
+    WAN scopes are disjoint too, so neither camp blocks on the other) and
+    nobody deadlocks or crosses camps."""
+    from dedloc_tpu.averaging import DecentralizedAverager
+    from dedloc_tpu.dht import DHT
+    from dedloc_tpu.telemetry.links import endpoint_key
+
+    n = 4
+    dhts = [DHT(start=True, listen_host="127.0.0.1")]
+    for _ in range(n - 1):
+        dhts.append(DHT(start=True, listen_host="127.0.0.1",
+                        initial_peers=[dhts[0].get_visible_address()]))
+    avgs = []
+    try:
+        for d in dhts:
+            avgs.append(DecentralizedAverager(
+                d, "mixed", averaging_expiration=1.0,
+                averaging_timeout=10.0, listen_host="127.0.0.1",
+                compression="none",
+            ))
+        keys = [endpoint_key(a.endpoint) for a in avgs]
+        cliques = [
+            CliquePlan(members=sorted(keys[0:2]), delegate=keys[0]),
+            CliquePlan(members=sorted(keys[2:4]), delegate=keys[2]),
+        ]
+        for i, a in enumerate(avgs):
+            a.set_topology_plan(TopologyPlan(
+                mode="hierarchical", reason="mixed-epoch rollout",
+                cliques=[CliquePlan(list(c.members), c.delegate)
+                         for c in cliques],
+                epoch=1 if i < 2 else 2,
+            ))
+        trees = [
+            {"w": rng.integers(0, 256, 17).astype(np.float32)}
+            for _ in range(n)
+        ]
+        out = {}
+
+        def one(i):
+            out[i] = avgs[i].step(trees[i], 1.0, "mix1")
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30)
+        assert len(out) == n, "a peer never returned (cross-epoch deadlock)"
+        camp = {
+            0: (trees[0]["w"] + trees[1]["w"]) * np.float32(0.5),
+            2: (trees[2]["w"] + trees[3]["w"]) * np.float32(0.5),
+        }
+        for i in range(n):
+            tree, size = out[i]
+            assert size == 2, f"peer {i} group size {size} (camps crossed?)"
+            np.testing.assert_array_equal(tree["w"], camp[0 if i < 2 else 2])
+    finally:
+        for a in avgs:
+            a.shutdown()
+        for d in dhts:
+            d.shutdown()
+
+
+# ----------------------------------------------------- multi-seed (slow)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [7, 11])
+def test_recovery_holds_across_seeds(seed):
+    """The acceptance bar is not a lucky seed: the same degrade recovers
+    >= 80% under different matchmaking orders, churn victims, and link
+    jitter draws."""
+    spec = copy.deepcopy(RECOVERY_SPEC)
+    spec["seed"] = seed
+    report = run_scenario(spec)
+    assert len(report["replans"]) == 1
+    assert [e["verdict"] for e in report["actuation_events"]] == [
+        "applied", "kept",
+    ]
+    sps = report["sps_by_fold"]
+    pre = _pre_fault_sps(report)
+    for s in sps[-2:]:
+        assert s >= 0.8 * pre, (seed, [round(x, 1) for x in sps])
+    assert report["averaging"]["exchange_failures"] == 0
+
+
+# -------------------------------------------- twin-retry transient (sat b)
+
+
+def test_retune_transient_failure_retries_then_names_reason(
+        tmp_path, monkeypatch):
+    """Satellite (b): a transiently-failing twin fit must NOT freeze the
+    incident behind a permanent no_recommendation — attempts below the
+    budget leave the incident re-dispatchable (no recommendation AND no
+    reason), and only the budget's final failure attaches the reason."""
+    from dedloc_tpu.roles import coordinator as coord
+    from dedloc_tpu.telemetry import watch as watch_mod
+
+    calls = {"n": 0}
+
+    def flaky_fit(rows):
+        calls["n"] += 1
+        raise OSError("metrics JSONL jammed mid-write")
+
+    monkeypatch.setattr(watch_mod, "twin_recommendation", flaky_fit)
+    metrics_log = tmp_path / "metrics.jsonl"
+    metrics_log.write_text("")
+    extra = coord.CoordinatorExtraArguments(
+        metrics_log_path=str(metrics_log),
+        incident_log_path=str(tmp_path / "incidents.jsonl"),
+        retune_max_attempts=3,
+    )
+    incident = {"id": "inc-9", "retune_eligible": True}
+    retunes = {"lock": threading.Lock(), "thread": None}
+    agg = {"time": 1.0, "step": 1}
+    for attempt in (1, 2):
+        coord._spawn_retune(incident, agg, extra, retunes)
+        retunes["thread"].join(timeout=10)
+        assert incident["retune_attempts"] == attempt
+        # still re-dispatchable: the _watch_fold eligibility re-check keys
+        # on BOTH fields being absent
+        assert "recommendation" not in incident
+        assert "recommendation_reason" not in incident
+    coord._spawn_retune(incident, agg, extra, retunes)
+    retunes["thread"].join(timeout=10)
+    assert calls["n"] == 3
+    assert "retune failed after 3 attempts" in (
+        incident["recommendation_reason"]
+    )
+    # the final transition landed on the incident JSONL for --incidents
+    rows = [json.loads(line) for line in
+            (tmp_path / "incidents.jsonl").read_text().splitlines()]
+    assert rows[-1]["transition"] == "recommendation"

@@ -551,11 +551,11 @@ def test_sim_port_concurrent_leaders_dissolve_into_one_group(sim_swarm):
         orig = mm._live_leaders
         state = {"first": True}
 
-        async def blind_once(round_id, _orig=orig, _state=state):
+        async def blind_once(round_id, scope="", _orig=orig, _state=state):
             if _state["first"]:
                 _state["first"] = False
                 return []
-            return await _orig(round_id)
+            return await _orig(round_id, scope)
 
         mm._live_leaders = blind_once
 
@@ -565,8 +565,8 @@ def test_sim_port_concurrent_leaders_dissolve_into_one_group(sim_swarm):
     mm3 = swarm.peers[3].matchmaking
     orig3 = mm3._live_leaders
 
-    async def reversed_view(round_id):
-        return list(reversed(await orig3(round_id)))
+    async def reversed_view(round_id, scope=""):
+        return list(reversed(await orig3(round_id, scope)))
 
     mm3._live_leaders = reversed_view
 

@@ -43,6 +43,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -64,6 +65,31 @@ def _fmt_value(metric: str, value) -> str:
             or "phase" in metric or "formation" in metric:
         return f"{v:.3f}s"
     return f"{v:.4g}"
+
+
+def format_effects(inc: dict) -> str:
+    """The incident's effects chain as one line. Plain metric effects stay
+    terse; guard-railed ``actuation``/``rollback`` entries (ActuationGuard)
+    show the applied config delta and the rail's verdict, so the
+    actuation -> rollback story is auditable straight from the summary."""
+    parts = []
+    for e in inc.get("effects") or []:
+        if e.get("applied") is not None:
+            part = f"{e['metric']}"
+            if e.get("fold") is not None:
+                part += f"@fold{e['fold']}"
+            part += f" {json.dumps(e['applied'], sort_keys=True)}"
+            if e.get("verdict"):
+                part += f" [{e['verdict']}]"
+            if e.get("deviation") is not None:
+                part += f" ({e['deviation'] * 100.0:+.0f}%)"
+        else:
+            part = f"{e['metric']}" + (
+                f" {e['deviation'] * 100.0:+.0f}%"
+                if e.get("deviation") is not None else ""
+            )
+        parts.append(part)
+    return ", ".join(parts)
 
 
 def format_incident(inc: dict) -> str:
@@ -99,27 +125,67 @@ def format_incident(inc: dict) -> str:
     )
     lines = [head, f"    {' '.join(where)}" if where else None, f"    {span}"]
     if inc.get("effects"):
-        effects = ", ".join(
-            f"{e['metric']}"
-            + (f" {e['deviation'] * 100.0:+.0f}%"
-               if e.get("deviation") is not None else "")
-            for e in inc["effects"]
-        )
-        lines.append(f"    effects: {effects}")
+        lines.append(f"    effects: {format_effects(inc)}")
     rec = inc.get("recommendation")
     if rec:
-        lo, hi = rec["interval"]
-        lines.append(
-            f"    twin recommends: {json.dumps(rec['config'])} — predicted "
-            f"{rec['predicted_samples_per_sec']:.1f} samples/sec "
-            f"[{lo:.1f}, {hi:.1f}] "
-            f"(fidelity ±{rec['fidelity_bound'] * 100.0:.0f}%)"
-        )
+        line = f"    twin recommends: {json.dumps(rec['config'])}"
+        # prediction metadata is optional: an operator-scripted or
+        # replayed recommendation carries only the config delta
+        if rec.get("predicted_samples_per_sec") is not None:
+            line += (
+                f" — predicted "
+                f"{rec['predicted_samples_per_sec']:.1f} samples/sec"
+            )
+            if rec.get("interval"):
+                lo, hi = rec["interval"]
+                line += f" [{lo:.1f}, {hi:.1f}]"
+            if rec.get("fidelity_bound") is not None:
+                line += f" (fidelity ±{rec['fidelity_bound'] * 100.0:.0f}%)"
+        lines.append(line)
     elif inc.get("recommendation_reason"):
         lines.append(
             f"    no recommendation: {inc['recommendation_reason']}"
         )
     return "\n".join(line for line in lines if line)
+
+
+def recorded_summary(rows) -> Optional[dict]:
+    """A watch summary built from the coordinator's RECORDED incident
+    JSONL (rows with ``watch: "incident"``), last transition per incident
+    winning — the same view ``runlog_summary --incidents`` renders. None
+    when the rows carry no recorded incidents."""
+    final: dict = {}
+    folds = 0
+    for r in rows:
+        inc = r.get("incident")
+        if r.get("watch") == "incident" and isinstance(inc, dict):
+            final[inc.get("id", len(final))] = inc
+            folds = max(folds, int(inc.get("opened_fold") or 0),
+                        int(inc.get("closed_fold") or 0))
+    if not final:
+        return None
+    ordered = sorted(
+        final.values(),
+        key=lambda i: (i.get("status") != "open", i.get("opened_fold", 0)),
+    )
+    return {
+        "verdict": {
+            "status": "recorded",
+            "reason": "coordinator incident log — recorded transitions, "
+                      "not a live health replay",
+        },
+        "folds": folds,
+        "incidents": ordered,
+        "open": sum(1 for i in ordered if i.get("status") == "open"),
+        "coverage": {
+            "folds": folds, "folds_with_topology": 0,
+            "folds_with_phases": 0, "folds_with_rounds": 0,
+            "peers_seen": 0,
+            "notes": ["recorded incident log: coverage counters "
+                      "unavailable (feed the coordinator metrics JSONL "
+                      "for a live replay)"],
+        },
+    }
 
 
 def print_watch(summary: dict, brief: bool = False) -> None:
@@ -132,8 +198,15 @@ def print_watch(summary: dict, brief: bool = False) -> None:
     )
     if brief:
         for inc in summary["incidents"]:
-            if inc["status"] == "open":
-                print(format_incident(inc).splitlines()[0])
+            if inc["status"] != "open":
+                continue
+            print(format_incident(inc).splitlines()[0])
+            # actuation/rollback chain stays visible even in brief mode:
+            # an operator paging through --brief must see what the closed
+            # loop changed on the swarm and whether the rail kept it
+            if any((e.get("applied") is not None)
+                   for e in inc.get("effects") or []):
+                print(f"    effects: {format_effects(inc)}")
         return
     if summary["incidents"]:
         print("\nincident timeline (open first):")
@@ -258,7 +331,10 @@ def main(argv=None) -> int:
     parser.add_argument("--recommend", action="store_true",
                         help="attach twin-backed retuning recommendations "
                              "to retune-eligible incidents (bounded sweep; "
-                             "recommendation only, nothing is applied)")
+                             "this tool only REPORTS them — the live "
+                             "coordinator applies eligible ones itself "
+                             "under the actuation guard rail unless "
+                             "--coordinator.actuate_retune false)")
     parser.add_argument("--seed", type=int, default=0,
                         help="twin replay seed for --recommend")
     parser.add_argument("--brief", action="store_true",
@@ -298,12 +374,23 @@ def main(argv=None) -> int:
 
     rows = load_jsonl_rows(paths)
     watch = watch_rows(rows)
-    if watch.coverage["folds"] == 0 and not args.brief:
-        sys.exit(
-            "no swarm_health records in the given file(s) — is this a "
-            "coordinator metrics JSONL? (per-peer event logs feed "
-            "runlog_summary --health/--steps instead)"
-        )
+    if watch.coverage["folds"] == 0:
+        # the coordinator's own incident JSONL (recorded transitions, no
+        # health rows): render the recorded incidents — the replay cannot
+        # recompute actuation/rollback effects, only the record has them
+        recorded = recorded_summary(rows)
+        if recorded is not None:
+            if args.json:
+                print(json.dumps(recorded, indent=1, default=str))
+            else:
+                print_watch(recorded, brief=args.brief)
+            return 0
+        if not args.brief:
+            sys.exit(
+                "no swarm_health records in the given file(s) — is this a "
+                "coordinator metrics JSONL? (per-peer event logs feed "
+                "runlog_summary --health/--steps instead)"
+            )
     if args.recommend:
         _attach_recommendations(watch, rows, args.seed)
     summary = watch.summary()
