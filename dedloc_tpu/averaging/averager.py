@@ -55,6 +55,13 @@ from dedloc_tpu.core.timeutils import get_dht_time
 from dedloc_tpu.dht.dht import DHT
 from dedloc_tpu.dht.protocol import RPCClient, RPCError, RPCServer
 from dedloc_tpu.telemetry import registry as telemetry
+from dedloc_tpu.telemetry.ledger import (
+    ContributionClaim,
+    parse_round_step,
+    publish_claim,
+    publish_receipt,
+    receipt_from_group,
+)
 from dedloc_tpu.telemetry.links import endpoint_key
 from dedloc_tpu.testing import faults
 from dedloc_tpu.utils.logging import get_logger
@@ -156,6 +163,12 @@ class DecentralizedAverager:
         # held plan and ultimately to flat (MAX_PLAN_FETCH_FAILURES).
         plan_follow: bool = False,
         plan_refresh_period: float = 30.0,  # dht-time seconds between polls
+        # contribution-ledger receipts (telemetry/ledger.py): countersign
+        # each finalized round's member set + declared weights into this
+        # peer's signed RoundReceipt DHT record. ON by default — receipts
+        # are what makes any peer's contribution claim checkable; a receipt
+        # failure only ever logs, it can never cost a round.
+        ledger_receipts: bool = True,
         # dht/transport.py seam for this peer's averaging RPC server and
         # client: None = real TCP (production); the swarm simulator injects
         # its in-process network here
@@ -237,6 +250,11 @@ class DecentralizedAverager:
         self._plan_issued = float("-inf")
         self._plan_fetch_failures = 0
         self._plan_next_refresh = 0.0
+        # contribution ledger (telemetry/ledger.py): this peer's cumulative
+        # witness table over group-mates' declared weights — refreshed into
+        # a signed RoundReceipt DHT record at every round finalization
+        self._ledger_witness: Dict[str, Dict[str, float]] = {}
+        self.ledger_receipts = bool(ledger_receipts)
 
         # build server+matchmaking+allreduce on the DHT loop
         def _setup(node):
@@ -571,6 +589,10 @@ class DecentralizedAverager:
         expected_size: Optional[int] = None,
         window: Optional[float] = None,
     ) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
+        # the round's declared sample weight rides the member record (and
+        # its signed join envelope in gated runs): what group-mates
+        # countersign in their contribution-ledger RoundReceipts
+        self.matchmaking.declared_weight = max(0.0, float(weight))
         plan = self._topology_plan
         if plan is not None and plan.mode == "hierarchical":
             return await self._step_hier(
@@ -667,6 +689,7 @@ class DecentralizedAverager:
         except AllreduceFailed as e:
             logger.warning(f"allreduce failed for {round_id}: {e}")
             return None, len(group.members)
+        self._emit_receipt(group, round_id, "flat")
         # a FlatTree result: the named views every existing consumer reads,
         # plus the flat buffer itself so a flat-native caller (the fused
         # flat apply) device_puts ONE array instead of per-leaf pieces
@@ -779,6 +802,7 @@ class DecentralizedAverager:
                 "avg.topology.round", round_id=round_id, role="gossip",
                 group_size=len(group.members), ok=True,
             )
+        self._emit_receipt(group, round_id, "gossip")
         return self._layout.tree_view(averaged), len(group.members)
 
     # ---------------------------------------------- hierarchical averaging
@@ -1039,6 +1063,11 @@ class DecentralizedAverager:
             except AllreduceFailed as e:
                 logger.warning(f"clique sum failed for {round_id}: {e}")
                 return await fallback("clique sum round failed", tree)
+            # the clique SUM leg is the receipt-bearing leg: every member
+            # (the delegate included) countersigns the declared weights it
+            # just reduced — the WAN leg carries pre-summed vectors whose
+            # weights are the norm_weight artifice, not peer declarations
+            self._emit_receipt(group, round_id, "clique")
         # else: singleton clique (or nobody joined a delegate's round) —
         # this peer IS its whole contribution and rides the WAN directly
 
@@ -1398,6 +1427,92 @@ class DecentralizedAverager:
                 np.frombuffer(raw, dtype=np.float32), CompressionType.NONE
             ),
         }
+
+    # ------------------------------------------------ contribution ledger
+
+    def _emit_receipt(self, group: GroupInfo, round_id: str,
+                      leg: str) -> None:
+        """Countersign a finalized round: fold the group's declared weights
+        into this peer's cumulative witness table and republish its signed
+        ``RoundReceipt`` DHT record (telemetry/ledger.py). Runs on the DHT
+        loop right after the leg's all-reduce lands. Best-effort by
+        contract: accounting must never cost the round that just
+        succeeded."""
+        if not self.ledger_receipts or len(group.members) < 2:
+            return
+        try:
+            member_weights = [
+                (m.peer_id.hex(), float(m.weight)) for m in group.members
+            ]
+            receipt = receipt_from_group(
+                self.peer_id.hex(), round_id,
+                parse_round_step(round_id), leg,
+                member_weights, self._ledger_witness,
+            )
+            publish_receipt(
+                self.dht, self.prefix, self.signed_subkey or self.peer_id,
+                receipt,
+            )
+            tele = telemetry.resolve(self.telemetry)
+            if tele is not None:
+                tele.counter("ledger.receipts").inc()
+                # the full receipt rides the event (hex ids, cumulative
+                # witness included), so an event-log-only fold reconstructs
+                # the same supported totals the DHT fold would
+                tele.event(
+                    "ledger.receipt", round_id=round_id, leg=leg,
+                    signer=receipt.signer, step=receipt.step,
+                    members=receipt.members, weights=receipt.weights,
+                    witness={
+                        p: {"samples": e.samples, "rounds": e.rounds}
+                        for p, e in receipt.witness.items()
+                    },
+                )
+        except Exception as e:  # noqa: BLE001 — see docstring
+            logger.warning(f"{round_id}: receipt publish failed: {e!r}")
+
+    def publish_contribution_claim(
+        self, samples: int, rounds: int, train_seconds: float,
+        expiration: float = 300.0,
+    ) -> None:
+        """Publish this peer's cumulative ``ContributionClaim`` DHT record
+        (schema-validated at every storing node; signature-bound when a
+        signed subkey was given). ``samples``/``rounds`` come from the
+        collaborative optimizer's cumulative counters; serve bytes read
+        straight off the existing ckpt/state counters, so a provider's
+        serving contribution needs no second bookkeeping."""
+        bytes_served = 0
+        tele = telemetry.resolve(self.telemetry)
+        if tele is not None:
+            bytes_served = int(
+                tele.counter("ckpt.shard_bytes_served").value
+                + tele.counter("state.served_bytes").value
+            )
+        try:
+            claim = ContributionClaim(
+                peer=self.peer_id.hex(),
+                samples=int(samples),
+                rounds=int(rounds),
+                train_seconds=float(max(0.0, train_seconds)),
+                bytes_served=bytes_served,
+                time=get_dht_time(),
+            )
+            publish_claim(
+                self.dht, self.prefix, self.signed_subkey or self.peer_id,
+                claim, expiration=expiration,
+            )
+        except Exception as e:  # noqa: BLE001 — accounting must never
+            # cost a training step
+            logger.warning(f"contribution claim publish failed: {e!r}")
+            return
+        if tele is not None:
+            tele.counter("ledger.claims").inc()
+            tele.event(
+                "ledger.claim", peer=claim.peer, samples=claim.samples,
+                rounds=claim.rounds,
+                train_seconds=round(claim.train_seconds, 3),
+                bytes_served=claim.bytes_served,
+            )
 
     def publish_checkpoint_announcement(
         self, expiration: float = 60.0

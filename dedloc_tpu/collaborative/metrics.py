@@ -79,6 +79,7 @@ def make_validators(
     signature-bound to that peer (dedloc_tpu/checkpointing/catalog.py)."""
     from dedloc_tpu.averaging.planwire import PlanRecord
     from dedloc_tpu.checkpointing.catalog import CheckpointAnnouncement
+    from dedloc_tpu.telemetry.ledger import ContributionClaim, RoundReceipt
 
     signature = RSASignatureValidator(private_key)
     schema = SchemaValidator(
@@ -89,6 +90,11 @@ def make_validators(
             # or out-of-range topology plan is rejected at the storing
             # node, not discovered mid-round by every adopting peer
             "topology_plan": PlanRecord,
+            # contribution accounting (telemetry/ledger.py): claims and
+            # round receipts are schema-checked at every storing node, so
+            # the coordinator's fold never sees a structurally bad record
+            "contribution_ledger": ContributionClaim,
+            "round_receipts": RoundReceipt,
         },
         prefix=prefix,
     )

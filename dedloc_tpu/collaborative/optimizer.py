@@ -204,6 +204,14 @@ class CollaborativeOptimizer:
         # averaged result device_puts as ONE buffer and the whole optimizer
         # update runs as segment reductions over it (make_flat_apply_step).
         # None (or any sharded layout) keeps the per-leaf guarded apply.
+        ledger_claims: bool = True,  # contribution ledger
+        # (telemetry/ledger.py): periodically publish this peer's signed
+        # cumulative ContributionClaim DHT record off the progress-report
+        # cadence; group-mates' RoundReceipts make it checkable
+        claim_period: float = 30.0,  # dht-time seconds between claims
+        ledger_receipts: bool = True,  # countersign averaging rounds into
+        # RoundReceipt records (forwarded to the averager, which owns the
+        # group envelope the receipt is built from)
     ):
         assert not (client_mode and auxiliary), "an auxiliary peer must listen"
         self.tx = tx
@@ -281,6 +289,7 @@ class CollaborativeOptimizer:
             topology_plan=topology_plan,
             plan_follow=plan_follow,
             plan_refresh_period=plan_refresh_period,
+            ledger_receipts=ledger_receipts,
         )
         self.tracker = ProgressTracker(
             dht,
@@ -358,6 +367,14 @@ class CollaborativeOptimizer:
         self.resync_step_gap = 8
         self._aux_misses = 0
         self._aux_withheld_at = 0.0
+        # contribution-ledger counters (telemetry/ledger.py): cumulative over
+        # this peer's lifetime, NOT zeroed at global steps (claim records are
+        # last-write-wins per signed subkey, so they must be monotone)
+        self.ledger_claims = bool(ledger_claims)
+        self.claim_period = float(claim_period)
+        self.contrib_samples_total = 0
+        self.contrib_rounds_total = 0
+        self._last_claim_t = 0.0
 
     # ------------------------------------------------------------ properties
 
@@ -389,6 +406,7 @@ class CollaborativeOptimizer:
                 # while a round assembles, not a boundary
                 tele.counter("opt.boundaries").inc()
             self.local_samples_accumulated += samples
+            self.contrib_samples_total += samples
             if self._ema_started:
                 # samples == 0 is a retry poll while a round assembles —
                 # neither progress nor throughput signal (and it must not
@@ -502,6 +520,18 @@ class CollaborativeOptimizer:
                 loss=self._last_loss,
             )
         )
+        if self.ledger_claims:
+            now = get_dht_time()
+            if now - self._last_claim_t >= self.claim_period:
+                self._last_claim_t = now
+                # claim expiry spans many claim periods so a peer that goes
+                # quiet stays creditable until the next coordinator fold
+                self.averager.publish_contribution_claim(
+                    self.contrib_samples_total,
+                    self.contrib_rounds_total,
+                    max(0.0, now - self._created_at),
+                    expiration=self.claim_period * 10.0,
+                )
 
     # --------------------------------------------- contribution ramp / gate
 
@@ -1248,6 +1278,7 @@ class CollaborativeOptimizer:
             )
         self.local_step = collab.optimizer_step + 1
         self._rounds_since_join += 1  # advances the contribution ramp
+        self.contrib_rounds_total += 1  # cumulative, for the signed claim
         self._overlap_cooldown = False  # a landed step re-arms overlap
         if keep_acc is None:
             self.local_samples_accumulated = 0

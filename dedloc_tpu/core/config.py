@@ -208,6 +208,11 @@ class AveragerArguments:
     # it outright even without a pin.
     plan_follow: bool = True
     plan_refresh_period: float = 30.0  # seconds between plan-record polls
+    # contribution-ledger receipts (telemetry/ledger.py): countersign each
+    # averaging round's group envelope into a signed RoundReceipt DHT
+    # record, making group-mates' cumulative claims checkable by the
+    # coordinator fold (docs/observability.md "signed contribution ledger")
+    ledger_receipts: bool = True
 
 
 @dataclass
@@ -267,6 +272,13 @@ class CollaborativeOptimizerArguments:
     # health-gated, and around state sync; a failed overlapped round falls
     # back to synchronous averaging (docs/fleet.md staleness contract).
     overlap_averaging: bool = False
+    # contribution-ledger claims (telemetry/ledger.py): periodically
+    # publish this peer's signed cumulative ContributionClaim DHT record
+    # (samples, rounds, wall-seconds, bytes served) so the coordinator can
+    # fold it against group-mates' receipts into the volunteer leaderboard
+    # (docs/observability.md "signed contribution ledger")
+    ledger_claims: bool = True
+    claim_period: float = 30.0  # dht-time seconds between claim refreshes
     # device-resident flat gradient pipeline (averaging/device_flat.py):
     # the boundary's mean/clip/error-feedback/quantize run in ONE fused jit
     # on the accelerator, and the (compressed, under fp16/uint8 wire

@@ -78,6 +78,15 @@ class CoordinatorExtraArguments:
     actuation_observe_folds: int = 3
     actuation_rollback_margin: float = 0.1
     actuation_max_per_epoch: int = 2
+    # contribution ledger (telemetry/ledger.py): each fold reads the signed
+    # claim + receipt records off the DHT, folds them into per-peer credit
+    # (credited = min(claimed, receipt-supported x slack)) and appends the
+    # cumulative state to its own JSONL — durable and restart-safe (the last
+    # row re-seeds the fold), gitignored like the other coordinator logs.
+    # Newly-flagged over-claims surface as watch.ledger events.
+    ledger_enabled: bool = True
+    ledger_log_path: str = "coordinator_ledger.jsonl"
+    ledger_slack: float = 1.25  # telemetry/ledger.DEFAULT_SLACK
     # hub publication (run_first_peer.py:123-147 capability): a git working
     # tree (optionally pushing to hub_git_remote) or a directory mirror
     hub_git_dir: str = ""
@@ -187,6 +196,15 @@ def run_coordinator(
                 "overlap": args.optimizer.overlap_averaging,
             },
         }
+    # contribution-ledger fold state: prev re-seeds from the last row of
+    # the durable JSONL, so a restarted coordinator keeps crediting peers
+    # whose records expired while it was down (flagged "stale")
+    ledger_state = None
+    if extra.ledger_enabled:
+        ledger_state = {
+            "prev": _prev_ledger(extra.ledger_log_path),
+            "flagged": {},
+        }
     prev_health = None
     prev_fold_t = None
     current_step = -1
@@ -246,6 +264,15 @@ def run_coordinator(
                         args, averager, current_step, upload_fn, uploads
                     )
                     last_upload = get_dht_time()
+
+            if ledger_state is not None:
+                # every refresh, NOT gated on metrics progress: claims and
+                # receipts live even in a swarm too young (or too wedged)
+                # to aggregate a metrics step yet
+                _ledger_fold(
+                    dht, args.dht.experiment_prefix, extra, ledger_state,
+                    t=get_dht_time(), step=current_step,
+                )
 
             iterations += 1
             if max_iterations and iterations >= max_iterations:
@@ -424,6 +451,112 @@ def _load_own_rows(path: str) -> list:
     from dedloc_tpu.utils.jsonl import load_jsonl_rows
 
     return load_jsonl_rows([path], warn=logger.warning, missing_ok=True)
+
+
+def _prev_ledger(path: str) -> Optional[dict]:
+    """Last folded ledger state in the durable JSONL (restart-safe seed
+    for the next fold); None on a fresh log. Reads through the hardened
+    loader, so a torn final line yields the last COMPLETE state."""
+    for row in reversed(_load_own_rows(path)):
+        if isinstance(row, dict) and isinstance(row.get("ledger"), dict):
+            return row["ledger"]
+    return None
+
+
+def _fetch_ledger_records(dht, prefix: str) -> tuple:
+    """(claims, receipts) currently live on the DHT, unpacked through the
+    same msgpack path the metrics bus uses and re-validated through the
+    pydantic schemas (defense in depth over the storing nodes' checks)."""
+    from dedloc_tpu.core.serialization import unpack_obj
+    from dedloc_tpu.telemetry.ledger import (
+        ledger_key,
+        parse_claims,
+        parse_receipts,
+        receipts_key,
+    )
+
+    def _items(key: str) -> list:
+        entry = dht.get(key, latest=True)
+        if entry is None or not hasattr(entry.value, "items"):
+            return []
+        out = []
+        for subkey, v in entry.value.items():
+            payload = v.value
+            if isinstance(payload, (bytes, bytearray)):
+                try:
+                    payload = unpack_obj(payload)
+                except Exception:  # noqa: BLE001 — undecodable record
+                    continue
+            out.append((subkey, payload))
+        return out
+
+    return (
+        parse_claims(_items(ledger_key(prefix))),
+        parse_receipts(_items(receipts_key(prefix))),
+    )
+
+
+def _ledger_fold(dht, prefix: str, extra, ledger_state, t, step) -> None:
+    """One contribution-ledger fold inline in the coordinator loop: fetch
+    the live claim/receipt records, fold them against the previous state
+    (telemetry/ledger.fold_ledger), append the cumulative result to the
+    durable ledger JSONL, and surface each NEWLY-flagged per-peer
+    discrepancy as a ``watch.ledger`` telemetry event + warning. A fold
+    that changes nothing but its timestamp is not re-appended, so an idle
+    swarm does not grow the log."""
+    from dedloc_tpu.telemetry.ledger import fold_ledger
+
+    try:
+        claims, receipts = _fetch_ledger_records(dht, prefix)
+    except Exception as e:  # noqa: BLE001 — a ledger fetch failure must
+        # never take the coordinator loop down; next refresh retries
+        logger.warning(f"ledger fetch failed: {e!r}")
+        return
+    prev = ledger_state.get("prev")
+    if not claims and not receipts and prev is None:
+        return  # pre-ledger swarm: nothing to fold, nothing to persist
+    folded = fold_ledger(
+        prev, claims, receipts, slack=extra.ledger_slack, now=t
+    )
+    changed = prev is None or any(
+        folded.get(k) != prev.get(k)
+        for k in ("peers", "claims", "receipt_signers")
+    )
+    ledger_state["prev"] = folded
+    if changed:
+        try:
+            with open(extra.ledger_log_path, "a") as f:
+                f.write(
+                    json.dumps({
+                        "t": folded["t"], "step": step, "ledger": folded,
+                    })
+                    + "\n"
+                )
+        except OSError as e:
+            logger.warning(f"cannot append ledger log: {e}")
+    for peer, entry in folded["peers"].items():
+        disc = entry.get("discrepancy")
+        if not disc:
+            ledger_state["flagged"].pop(peer, None)
+            continue
+        if ledger_state["flagged"].get(peer) == disc.get("kind"):
+            continue  # already surfaced; only a kind change re-fires
+        ledger_state["flagged"][peer] = disc.get("kind")
+        telemetry.inc("ledger.discrepancies")
+        telemetry.event(
+            "watch.ledger",
+            peer=peer,
+            kind=disc.get("kind"),
+            claimed_samples=disc.get("claimed_samples"),
+            supported_samples=disc.get("supported_samples"),
+            ratio=disc.get("ratio"),
+            step=step,
+        )
+        logger.warning(
+            f"ledger discrepancy [{disc.get('kind')}] peer {peer}: "
+            f"claimed {disc.get('claimed_samples')} vs receipt-supported "
+            f"{disc.get('supported_samples')}"
+        )
 
 
 def _append_incident(extra, t, step, transition, incident) -> None:

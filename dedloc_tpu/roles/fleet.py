@@ -140,6 +140,8 @@ class LocalFleet:
                 str(a.coordinator_refresh_period),
                 "--coordinator.metrics_log_path",
                 os.path.join(a.output_dir, "coordinator_metrics.jsonl"),
+                "--coordinator.ledger_log_path",
+                os.path.join(a.output_dir, "coordinator_ledger.jsonl"),
             ],
         )
 

@@ -258,6 +258,11 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         plan_refresh_period=args.averager.plan_refresh_period,
         error_feedback=args.optimizer.error_feedback,
         overlap_averaging=args.optimizer.overlap_averaging,
+        # signed contribution ledger (--optimizer.ledger_claims /
+        # --averager.ledger_receipts; docs/observability.md)
+        ledger_claims=args.optimizer.ledger_claims,
+        claim_period=args.optimizer.claim_period,
+        ledger_receipts=args.averager.ledger_receipts,
         target_group_size=args.averager.target_group_size,
         averaging_expiration=args.averager.averaging_expiration,
         averaging_timeout=args.averager.averaging_timeout,

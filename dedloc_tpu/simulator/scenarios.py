@@ -1674,6 +1674,198 @@ async def _scenario_hierarchical(run: ScenarioRun) -> None:
     }
 
 
+# ----------------------------------------------------- ledger scenario
+#
+# The contribution-ledger acceptance scenario: receipt-backed swarm
+# accounting, entirely in virtual time. Peers form REAL matchmaking groups
+# each round with their declared per-round weight riding the signed join
+# envelope (Member.weight over the sim DHT wire), countersign receipts
+# with the REAL receipt_from_group, and publish schema-checked claims as
+# DHT records — one peer INFLATES its cumulative claim, one serves most
+# checkpoint bytes. A coordinator-shaped fold runs the REAL fold_ledger
+# after every round; the dumped ledger JSONL must replay bit-identically
+# (tests/test_ledger.py), honest peers land within 5% of scripted ground
+# truth, and the inflator is capped at its receipt-supported total with a
+# named discrepancy.
+
+
+async def _scenario_ledger(run: ScenarioRun) -> None:
+    """Spec section ``ledger`` (all keys optional)::
+
+        ledger:
+          inflate_peer: peer-0001   # claims inflate_factor x its true work
+          inflate_factor: 10.0
+          serve_peer: peer-0002     # scripted checkpoint-serving bytes
+          serve_bytes: 67108864
+          slack: 1.25
+    """
+    from dedloc_tpu.telemetry.ledger import (
+        ContributionClaim,
+        fold_ledger,
+        leaderboard,
+        ledger_key,
+        parse_claims,
+        parse_receipts,
+        receipt_from_group,
+        receipts_key,
+    )
+
+    await phase_spawn(run)
+    spec = run.spec
+    lspec = dict(spec.get("ledger") or {})
+    rounds = int(spec.get("avg_rounds", 6))
+    window = float(spec.get("window_s", 5.0))
+    samples_per_round = (
+        int(spec.get("boundaries", 2)) * int(spec.get("samples_per_boundary", 16))
+    )
+    prefix = str(spec.get("prefix", "simexp"))
+    slack = float(lspec.get("slack", 1.25))
+    inflate_factor = float(lspec.get("inflate_factor", 10.0))
+    participants = run.swarm.alive_peers()
+    if len(participants) < 3:
+        raise ValueError("ledger scenario needs >= 3 live peers")
+    # one group spanning the whole swarm per round: every mate's witness
+    # table then covers every round, so HONEST credit is exact and the 5%
+    # acceptance bar measures only the fold, not matchmaking splits
+    group_size = int(spec.get("group_size", len(participants)))
+    labels = [p.label for p in participants]
+    inflate_peer = str(lspec.get("inflate_peer", labels[1]))
+    serve_peer = str(lspec.get("serve_peer", labels[2]))
+    serve_bytes = int(lspec.get("serve_bytes", 64 * 1024 * 1024))
+
+    for peer in participants:
+        peer.attach_matchmaking(
+            prefix, bandwidth=100.0, target_group_size=group_size,
+            averaging_expiration=window,
+        )
+        # the declared per-round weight rides the signed join envelope
+        peer.matchmaking.declared_weight = float(samples_per_round)
+
+    hex_by_label = {
+        p.label: p.node.node_id.to_bytes().hex() for p in participants
+    }
+    truth = {p.label: {"samples": 0, "rounds": 0} for p in participants}
+    witnesses: Dict[str, Dict[str, Dict[str, float]]] = {
+        p.label: {} for p in participants
+    }
+    ledger_rows: List[Dict[str, Any]] = []
+    prev_fold = None
+    t0 = get_dht_time()
+
+    def _items(entry) -> list:
+        return (
+            [(sk, v.value) for sk, v in entry.value.items()]
+            if entry is not None and hasattr(entry.value, "items")
+            else []
+        )
+
+    for r in range(rounds):
+        round_id = f"ledround-{r:04d}"
+        alive = [p for p in participants if p.alive]
+
+        async def one(peer):
+            try:
+                return peer, await peer.matchmaking.form_group(round_id)
+            except Exception:  # noqa: BLE001 — skipped this round
+                return peer, None
+
+        formed = await asyncio.gather(*(one(p) for p in alive))
+        for peer, group in formed:
+            if group is None or len(group.members) < 2:
+                continue
+            # the receipt covers the envelope identities + declared
+            # weights the signer verified at join — built by the SAME
+            # helper the runtime averager calls at round finalization
+            member_weights = [
+                (m.peer_id.hex(), float(m.weight)) for m in group.members
+            ]
+            receipt = receipt_from_group(
+                hex_by_label[peer.label], round_id, -1, "flat",
+                member_weights, witnesses[peer.label],
+            )
+            truth[peer.label]["samples"] += samples_per_round
+            truth[peer.label]["rounds"] += 1
+            peer.telemetry.counter("ledger.receipts").inc()
+            peer.telemetry.event(
+                "ledger.receipt",
+                signer=receipt.signer, round_id=receipt.round_id,
+                step=receipt.step, leg=receipt.leg,
+                members=list(receipt.members),
+                weights=list(receipt.weights),
+                witness={
+                    p: {"samples": e.samples, "rounds": e.rounds}
+                    for p, e in receipt.witness.items()
+                },
+            )
+            await peer.node.store(
+                receipts_key(prefix).encode(), receipt.model_dump(),
+                get_dht_time() + 3600.0, subkey=peer.label.encode(),
+            )
+        # cumulative claims (last-write-wins per peer, like the one signed
+        # subkey slot production enforces); the inflator multiplies its
+        # TRUE total — its per-round declared weights stayed honest, which
+        # is exactly the attack receipts catch
+        for peer in alive:
+            tr = truth[peer.label]
+            claimed = tr["samples"]
+            if peer.label == inflate_peer:
+                claimed = int(claimed * inflate_factor)
+            bytes_served = (
+                serve_bytes if peer.label == serve_peer
+                else 1024 * (r + 1)
+            )
+            claim = ContributionClaim(
+                peer=hex_by_label[peer.label],
+                samples=int(claimed),
+                rounds=int(tr["rounds"]),
+                train_seconds=round(get_dht_time() - t0, 3),
+                bytes_served=int(bytes_served),
+                time=get_dht_time(),
+            )
+            peer.telemetry.counter("ledger.claims").inc()
+            peer.telemetry.event(
+                "ledger.claim", peer=claim.peer, samples=claim.samples,
+                rounds=claim.rounds, train_seconds=claim.train_seconds,
+                bytes_served=claim.bytes_served,
+            )
+            await peer.node.store(
+                ledger_key(prefix).encode(), claim.model_dump(),
+                get_dht_time() + 3600.0, subkey=peer.label.encode(),
+            )
+        # coordinator-shaped fold off the live DHT view, through the SAME
+        # parse + fold path roles/coordinator.py runs
+        reader = alive[0]
+        centry = await reader.node.get(ledger_key(prefix).encode(), latest=True)
+        rentry = await reader.node.get(
+            receipts_key(prefix).encode(), latest=True
+        )
+        folded = fold_ledger(
+            prev_fold, parse_claims(_items(centry)),
+            parse_receipts(_items(rentry)), slack=slack, now=get_dht_time(),
+        )
+        prev_fold = folded
+        ledger_rows.append({"t": folded["t"], "step": r, "ledger": folded})
+        # let leader-entry expirations clear so rounds stay disjoint
+        await asyncio.sleep(window + 1.0)
+
+    run.report["ledger_rows"] = ledger_rows
+    run.report["ledger"] = prev_fold
+    run.report["leaderboard"] = leaderboard(prev_fold) if prev_fold else []
+    run.report["truth"] = {
+        label: {**tr, "peer": hex_by_label[label]}
+        for label, tr in truth.items()
+    }
+    run.report["samples_per_round"] = samples_per_round
+    run.report["inflate"] = {
+        "label": inflate_peer, "peer": hex_by_label.get(inflate_peer),
+        "factor": inflate_factor,
+    }
+    run.report["serve"] = {
+        "label": serve_peer, "peer": hex_by_label.get(serve_peer),
+        "bytes": serve_bytes,
+    }
+
+
 SCENARIOS: Dict[str, Callable] = {
     "dht_churn": _scenario_dht_churn,
     "matchmaking": _scenario_matchmaking,
@@ -1683,6 +1875,7 @@ SCENARIOS: Dict[str, Callable] = {
     "hierarchical": _scenario_hierarchical,
     "watchdog": _scenario_watchdog,
     "closed_loop": _scenario_closed_loop,
+    "ledger": _scenario_ledger,
     # resolved specially by run_scenario: replays a fitted TwinModel
     # (dedloc_tpu/twin) instead of building a swarm from spec numbers
     "twin_replay": None,
@@ -1765,6 +1958,15 @@ def run_scenario(
                         for row in run.report["health_folds"]:
                             f.write(json.dumps(row) + "\n")
                     run.report["coordinator_log"] = path
+                if run.report.get("ledger_rows"):
+                    # the coordinator's ledger-JSONL shape (one row per
+                    # fold, last state wins) — what runlog_summary
+                    # --contributions reads and replays bit-identically
+                    path = os.path.join(out_dir, "ledger.jsonl")
+                    with open(path, "w", encoding="utf-8") as f:
+                        for row in run.report["ledger_rows"]:
+                            f.write(json.dumps(row) + "\n")
+                    run.report["ledger_log"] = path
                 if run.report.get("incident_rows"):
                     # the coordinator's incident-JSONL shape (one row per
                     # transition, last state per id wins) — what

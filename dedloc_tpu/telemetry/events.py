@@ -52,6 +52,11 @@ FAULT_APPLIED = "fault.applied"
 FAULT_INJECTED = "fault.injected"
 FAULTS_APPLIED = "faults.applied"
 FAULTS_INJECTED = "faults.injected"
+LEDGER_CLAIM = "ledger.claim"
+LEDGER_CLAIMS = "ledger.claims"
+LEDGER_DISCREPANCIES = "ledger.discrepancies"
+LEDGER_RECEIPT = "ledger.receipt"
+LEDGER_RECEIPTS = "ledger.receipts"
 LINK_STATS = "link.stats"
 METRICS_MALFORMED_RECORDS = "metrics.malformed_records"
 MM_FORM_GROUP = "mm.form_group"
@@ -122,6 +127,7 @@ STEP_WALL = "step.wall"
 WATCH_ACTUATION = "watch.actuation"
 WATCH_ACTUATIONS = "watch.actuations"
 WATCH_INCIDENT = "watch.incident"
+WATCH_LEDGER = "watch.ledger"
 WATCH_ROLLBACK = "watch.rollback"
 WATCH_ROLLBACKS = "watch.rollbacks"
 
@@ -150,6 +156,9 @@ COUNTERS = frozenset({
     "ckpt.verify_failures",
     "faults.applied",
     "faults.injected",
+    "ledger.claims",
+    "ledger.discrepancies",
+    "ledger.receipts",
     "metrics.malformed_records",
     "mm.join_failures",
     "mm.leader_changes",
@@ -228,6 +237,8 @@ EVENTS = frozenset({
     "ckpt.shard_verify_failure",
     "fault.applied",
     "fault.injected",
+    "ledger.claim",
+    "ledger.receipt",
     "link.stats",
     "mm.form_group",
     "mm.join.serve",
@@ -258,6 +269,7 @@ EVENTS = frozenset({
     "step.record",
     "watch.actuation",
     "watch.incident",
+    "watch.ledger",
     "watch.rollback",
 })
 SPANS = frozenset({

@@ -1,0 +1,574 @@
+"""The signed contribution ledger (receipt-backed swarm accounting).
+
+Acceptance (all virtual-time, deterministic): the ``ledger`` simulator
+scenario — 12 peers, one inflating its cumulative claim 10x, one serving
+most checkpoint bytes — credits every honest peer within 5% of scripted
+ground truth, caps the inflator at its receipt-supported total (x slack)
+with a named ``overclaim`` discrepancy, renders both on the volunteer
+leaderboard (``runlog_summary --contributions`` and the ``swarm_watch
+--brief`` one-liner), and the fold replays BIT-IDENTICALLY from the
+dumped ledger JSONL and from per-peer event logs. Hostile inputs (jammed
+/ truncated JSONL, pre-ledger fleets, empty swarms) degrade with named
+coverage notes, never false discrepancies or crashes.
+"""
+import copy
+import importlib.util
+import json
+from pathlib import Path
+
+import pytest
+from pydantic import ValidationError
+
+from dedloc_tpu.averaging.matchmaking import Member
+from dedloc_tpu.simulator.scenarios import run_scenario
+from dedloc_tpu.telemetry.ledger import (
+    DEFAULT_SLACK,
+    MAX_WITNESS,
+    ContributionClaim,
+    RoundReceipt,
+    WitnessEntry,
+    fold_ledger,
+    leaderboard,
+    ledger_key,
+    parse_claims,
+    parse_receipts,
+    parse_round_step,
+    receipt_from_group,
+    receipts_key,
+    update_witness,
+)
+
+pytestmark = pytest.mark.simulator
+
+_TOOLS = Path(__file__).resolve().parent.parent / "tools"
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(name, _TOOLS / f"{name}.py")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+# order matters: swarm_watch resolves `runlog_summary` via sys.modules
+runlog_summary = _load_tool("runlog_summary")
+import sys  # noqa: E402
+
+sys.modules.setdefault("runlog_summary", runlog_summary)
+swarm_watch = _load_tool("swarm_watch")
+
+
+# ------------------------------------------------------------- unit: schema
+
+
+def _claim(**over):
+    base = dict(peer="aa" * 16, samples=100, rounds=5, train_seconds=60.0,
+                bytes_served=0, time=1000.0)
+    base.update(over)
+    return ContributionClaim.model_validate(base)
+
+
+def _receipt(**over):
+    base = dict(signer="aa", round_id="step7", step=7, leg="flat",
+                members=["aa", "bb"], weights=[32.0, 32.0],
+                witness={"bb": {"samples": 32.0, "rounds": 1}}, time=1000.0)
+    base.update(over)
+    return RoundReceipt.model_validate(base)
+
+
+def test_claim_schema_accepts_and_rejects():
+    claim = _claim()
+    assert claim.samples == 100
+    for bad in (
+        {"samples": -1},
+        {"rounds": -2},
+        {"bytes_served": -5},
+        {"train_seconds": float("nan")},
+        {"train_seconds": -1.0},
+        {"time": float("inf")},
+        {"peer": ""},
+        {"peer": "x" * 200},
+        {"samples": 1.5},  # StrictInt: a float smuggled in is rejected
+    ):
+        with pytest.raises(ValidationError):
+            _claim(**bad)
+
+
+def test_receipt_schema_accepts_and_rejects():
+    receipt = _receipt()
+    assert receipt.witness["bb"].samples == 32.0
+    for bad in (
+        {"leg": "wan"},  # only flat/gossip/clique legs exist
+        {"members": ["bb", "aa"]},  # must be strictly sorted
+        {"members": ["aa", "aa"]},  # and unique
+        {"members": ["aa"]},  # a receipt needs a counterparty
+        {"weights": [32.0]},  # alignment
+        {"weights": [-1.0, 2.0]},
+        {"signer": "zz"},  # signer must be a member
+        {"step": -2},
+        {"witness": {f"p{i}": {"samples": 1.0, "rounds": 1}
+                     for i in range(MAX_WITNESS + 1)}},
+    ):
+        with pytest.raises(ValidationError):
+            _receipt(**bad)
+
+
+def test_parse_drops_malformed_keeps_valid():
+    good = _claim().model_dump()
+    claims = parse_claims([
+        (b"k1", good),
+        (b"k2", {"peer": "bb", "samples": -3}),  # malformed
+        (b"k3", "not a dict"),
+    ])
+    assert [c.peer for c in claims] == [good["peer"]]
+    receipts = parse_receipts([
+        (b"k1", _receipt().model_dump()),
+        (b"k2", {"signer": "aa"}),
+    ])
+    assert len(receipts) == 1
+
+
+def test_parse_round_step():
+    assert parse_round_step("step42") == 42
+    assert parse_round_step("step_7") == 7
+    assert parse_round_step("avground-0003") == -1
+    assert parse_round_step("") == -1
+
+
+def test_keys():
+    assert ledger_key("exp") == "exp_contribution_ledger"
+    assert receipts_key("exp") == "exp_round_receipts"
+
+
+# ---------------------------------------------------------- unit: witness
+
+
+def test_update_witness_accumulates_and_bounds():
+    witness = {}
+    update_witness(witness, [("bb", 32.0), ("cc", 16.0)])
+    update_witness(witness, [("bb", 32.0)])
+    assert witness["bb"] == {"samples": 64.0, "rounds": 2}
+    assert witness["cc"] == {"samples": 16.0, "rounds": 1}
+    # bound: the smallest-sample tail is dropped, top entries kept
+    update_witness(
+        witness,
+        [(f"p{i:04d}", 1000.0 + i) for i in range(MAX_WITNESS + 10)],
+    )
+    assert len(witness) == MAX_WITNESS
+    assert "p0009" not in witness  # smallest of the big batch, evicted
+    assert "cc" not in witness  # tiny witness total, evicted first
+
+
+def test_receipt_from_group_excludes_self_from_witness():
+    witness = {}
+    receipt = receipt_from_group(
+        "bb", "step3", 3, "flat",
+        [("bb", 32.0), ("aa", 16.0), ("cc", 8.0)], witness,
+    )
+    assert receipt.members == ["aa", "bb", "cc"]  # sorted
+    assert receipt.weights == [16.0, 32.0, 8.0]  # aligned to members
+    assert set(receipt.witness) == {"aa", "cc"}  # never the signer
+    assert witness["aa"] == {"samples": 16.0, "rounds": 1}
+
+
+# ------------------------------------------------------------- unit: fold
+
+
+def _w(samples, rounds=1):
+    return WitnessEntry(samples=float(samples), rounds=int(rounds))
+
+
+def test_fold_pre_ledger_credits_as_claimed():
+    folded = fold_ledger(None, [_claim(samples=500)], [], now=2000.0)
+    entry = folded["peers"]["aa" * 16]
+    assert entry["coverage"] == "pre-ledger"
+    assert entry["credited_samples"] == 500
+    assert entry["discrepancy"] is None
+    assert folded["discrepancies"] == 0
+
+
+def test_fold_overclaim_capped_and_named():
+    receipt = _receipt(signer="bb", members=["aa" * 16, "bb"],
+                       weights=[100.0, 100.0],
+                       witness={"aa" * 16: {"samples": 100.0, "rounds": 5}})
+    folded = fold_ledger(
+        None, [_claim(samples=1000, rounds=5)], [receipt], now=2000.0,
+    )
+    entry = folded["peers"]["aa" * 16]
+    assert entry["coverage"] == "receipts"
+    assert entry["credited_samples"] == int(100 * DEFAULT_SLACK)
+    assert entry["discrepancy"]["kind"] == "overclaim"
+    assert entry["discrepancy"]["ratio"] == 10.0
+
+
+def test_fold_supported_is_max_not_sum():
+    """Two signers witnessing the same cumulative total must not add up —
+    witness tables are cumulative maxima over shared rounds."""
+    mk = lambda signer: _receipt(  # noqa: E731
+        signer=signer, members=[signer, "pp"], weights=[10.0, 10.0],
+        witness={"pp": {"samples": 60.0, "rounds": 3}},
+    )
+    folded = fold_ledger(
+        None, [_claim(peer="pp", samples=120, rounds=3)],
+        [mk("aa"), mk("bb")], now=2000.0,
+    )
+    entry = folded["peers"]["pp"]
+    assert entry["supported_samples"] == 60.0  # max, not 120
+    assert entry["credited_samples"] == int(60 * DEFAULT_SLACK)
+
+
+def test_fold_self_witness_does_not_support():
+    receipt = _receipt(
+        signer="aa" * 16, members=["aa" * 16, "bb"], weights=[9.0, 9.0],
+        witness={"bb": {"samples": 9.0, "rounds": 1}},
+    )
+    folded = fold_ledger(None, [_claim(samples=90)], [receipt], now=2000.0)
+    entry = folded["peers"]["aa" * 16]
+    # receipts exist, but only the peer's OWN — it stays unwitnessed
+    assert entry["coverage"] == "unwitnessed"
+    assert entry["credited_samples"] == 0
+    assert entry["discrepancy"]["kind"] == "unwitnessed"
+
+
+def test_fold_receipts_only_credits_witnessed_total():
+    receipt = _receipt(signer="bb", members=["bb", "cc"],
+                       weights=[5.0, 5.0],
+                       witness={"cc": {"samples": 40.0, "rounds": 4}})
+    folded = fold_ledger(None, [], [receipt], now=2000.0)
+    entry = folded["peers"]["cc"]
+    assert entry["coverage"] == "receipts-only"
+    assert entry["credited_samples"] == 40
+    assert entry["credited_rounds"] == 4
+    assert entry["discrepancy"] is None
+
+
+def test_fold_prev_carryover_marked_stale():
+    prev = fold_ledger(None, [_claim(samples=500)], [], now=2000.0)
+    folded = fold_ledger(prev, [], [], now=3000.0)
+    entry = folded["peers"]["aa" * 16]
+    assert entry["coverage"] == "stale"
+    assert entry["credited_samples"] == 500
+    # and a returning live record supersedes the stale carry-over
+    folded2 = fold_ledger(folded, [_claim(samples=600)], [], now=4000.0)
+    assert folded2["peers"]["aa" * 16]["credited_samples"] == 600
+    assert folded2["peers"]["aa" * 16]["coverage"] == "pre-ledger"
+
+
+def test_fold_latest_claim_per_peer_wins():
+    folded = fold_ledger(
+        None,
+        [_claim(samples=100, time=1000.0), _claim(samples=200, time=1500.0)],
+        [], now=2000.0,
+    )
+    assert folded["peers"]["aa" * 16]["claimed_samples"] == 200
+
+
+def test_leaderboard_ranking_and_share():
+    folded = fold_ledger(
+        None,
+        [_claim(peer="aa", samples=300), _claim(peer="bb", samples=100),
+         _claim(peer="cc", samples=100, bytes_served=999)],
+        [], now=2000.0,
+    )
+    board = leaderboard(folded)
+    assert [e["peer"] for e in board] == ["aa", "cc", "bb"]  # bytes break tie
+    assert board[0]["share"] == 0.6
+    assert sum(e["share"] for e in board) == pytest.approx(1.0)
+
+
+# -------------------------------------------------- member wire back-compat
+
+
+def test_member_weight_rides_envelope_and_defaults():
+    m = Member(peer_id=b"p1", endpoint=("h", 1), bandwidth=10.0,
+               weight=32.0)
+    assert Member.unpack(m.pack()).weight == 32.0
+    # a pre-ledger peer's 6-field envelope unpacks with weight 0.0
+    legacy = Member.unpack(m.pack()[:6])
+    assert legacy.weight == 0.0 and legacy.peer_id == b"p1"
+
+
+# ------------------------------------------------------ scenario acceptance
+
+
+LEDGER_SPEC = {
+    "scenario": "ledger", "peers": 12, "avg_rounds": 6, "seed": 0,
+    "boundaries": 2, "samples_per_boundary": 16, "window_s": 5.0,
+}
+
+
+@pytest.fixture(scope="module")
+def ledger_run(tmp_path_factory):
+    out = tmp_path_factory.mktemp("ledger_scenario")
+    report = run_scenario(copy.deepcopy(LEDGER_SPEC), out_dir=str(out))
+    return report, out
+
+
+def test_scenario_honest_peers_within_5pct(ledger_run):
+    report, _out = ledger_run
+    inflator = report["inflate"]["peer"]
+    for label, tr in report["truth"].items():
+        if tr["peer"] == inflator:
+            continue
+        entry = report["ledger"]["peers"][tr["peer"]]
+        assert entry["credited_samples"] == pytest.approx(
+            tr["samples"], rel=0.05
+        ), label
+        assert entry["discrepancy"] is None, label
+
+
+def test_scenario_inflator_capped_with_named_discrepancy(ledger_run):
+    report, _out = ledger_run
+    inflator = report["inflate"]["peer"]
+    truth = next(
+        tr for tr in report["truth"].values() if tr["peer"] == inflator
+    )
+    entry = report["ledger"]["peers"][inflator]
+    slack = report["ledger"]["slack"]
+    assert entry["claimed_samples"] == truth["samples"] * 10
+    # capped at the receipt-supported total x slack, nothing more
+    assert entry["credited_samples"] <= int(truth["samples"] * slack) + 1
+    assert entry["discrepancy"]["kind"] == "overclaim"
+    assert entry["discrepancy"]["ratio"] == pytest.approx(10.0, rel=0.05)
+    assert report["ledger"]["discrepancies"] == 1
+
+
+def test_scenario_leaderboard_renders_both(ledger_run):
+    report, _out = ledger_run
+    board = report["leaderboard"]
+    flagged = [e for e in board if e["discrepancy"]]
+    assert [e["peer"] for e in flagged] == [report["inflate"]["peer"]]
+    served = max(board, key=lambda e: e["bytes_served"])
+    assert served["peer"] == report["serve"]["peer"]
+    assert served["bytes_served"] == report["serve"]["bytes"]
+
+
+def test_scenario_replay_bit_identical(ledger_run):
+    """The dumped ledger JSONL replays to the identical state, and an
+    identical re-run of the spec reproduces the dump byte for byte."""
+    report, out = ledger_run
+    rows = runlog_summary.load_jsonl_rows([str(out / "ledger.jsonl")])
+    assert json.dumps(rows[-1]["ledger"], sort_keys=True) == json.dumps(
+        report["ledger"], sort_keys=True
+    )
+    rerun = run_scenario(copy.deepcopy(LEDGER_SPEC))
+    assert json.dumps(rerun["ledger_rows"], sort_keys=True) == json.dumps(
+        report["ledger_rows"], sort_keys=True
+    )
+
+
+def test_contributions_recorded_vs_replayed_agree(ledger_run):
+    """--contributions over the coordinator-shaped ledger JSONL (recorded)
+    and over per-peer event logs (refolded from ledger.claim/ledger.receipt
+    events) must produce the same leaderboard."""
+    report, out = ledger_run
+    recorded = runlog_summary.contributions_data(
+        runlog_summary.load_jsonl_rows([str(out / "ledger.jsonl")])
+    )
+    event_logs = sorted(str(p) for p in out.glob("peer-*.jsonl"))
+    replayed = runlog_summary.contributions_data(
+        runlog_summary.load_jsonl_rows(event_logs)
+    )
+    assert recorded["source"] == "recorded"
+    assert replayed["source"] == "replayed"
+    assert recorded["leaderboard"] == replayed["leaderboard"]
+    assert recorded["discrepancies"] == replayed["discrepancies"] == 1
+
+
+def test_contributions_text_rendering(ledger_run, capsys):
+    report, out = ledger_run
+    rows = runlog_summary.load_jsonl_rows([str(out / "ledger.jsonl")])
+    runlog_summary.print_contributions(rows)
+    text = capsys.readouterr().out
+    assert "volunteer leaderboard" in text
+    assert "OVERCLAIM" in text
+    assert report["inflate"]["peer"][:12] in text
+    assert report["serve"]["peer"][:12] in text
+
+
+def test_swarm_watch_brief_ledger_line(ledger_run, capsys):
+    report, out = ledger_run
+    swarm_watch.ledger_brief(
+        runlog_summary.load_jsonl_rows([str(out / "ledger.jsonl")])
+    )
+    line = capsys.readouterr().out.strip()
+    assert line.startswith("ledger: top ")
+    assert "1 discrepancy(ies)" in line
+    assert report["inflate"]["peer"][:12] in line
+
+
+@pytest.mark.slow
+def test_scenario_multi_seed_sweep():
+    """Heavyweight cross-seed invariants: the credit formula's guarantees
+    hold under different matchmaking timings, not just seed 0."""
+    for seed in (1, 2, 3):
+        spec = {**copy.deepcopy(LEDGER_SPEC), "seed": seed}
+        report = run_scenario(spec)
+        ledger = report["ledger"]
+        assert ledger["discrepancies"] == 1
+        inflator = report["inflate"]["peer"]
+        assert ledger["peers"][inflator]["discrepancy"]["kind"] == "overclaim"
+        slack = ledger["slack"]
+        for tr in report["truth"].values():
+            entry = ledger["peers"][tr["peer"]]
+            # NOBODY is ever credited above slack x their true work
+            assert entry["credited_samples"] <= tr["samples"] * slack + 1
+
+
+# ------------------------------------------------ receipts over the sim wire
+
+
+def test_weight_rides_real_matchmaking_envelope(sim_swarm):
+    """The declared weight survives the REAL matchmaking wire (pack →
+    sim DHT RPC → unpack): every member of a formed group reads every
+    other member's declared weight off the verified join envelope."""
+    engine, swarm = sim_swarm(4)
+    weights = {}
+    for i, peer in enumerate(swarm.alive_peers()):
+        mm = peer.attach_matchmaking(
+            "wiretest", target_group_size=4, averaging_expiration=5.0
+        )
+        mm.declared_weight = 10.0 * (i + 1)
+        weights[peer.node.node_id.to_bytes().hex()] = mm.declared_weight
+
+    async def _form():
+        import asyncio
+
+        async def one(p):
+            try:
+                return await p.matchmaking.form_group("wt-round-0")
+            except Exception:  # noqa: BLE001 — asserted below via None
+                return None
+
+        return await asyncio.gather(
+            *(one(p) for p in swarm.alive_peers())
+        )
+
+    groups = [g for g in engine.run(_form()) if g is not None]
+    assert groups, "no group formed"
+    full = max(groups, key=lambda g: len(g.members))
+    assert len(full.members) >= 2
+    for m in full.members:
+        assert m.weight == weights[m.peer_id.hex()]
+    # and the receipt built from that envelope carries the declarations
+    signer = full.members[0].peer_id.hex()
+    receipt = receipt_from_group(
+        signer, "wt-round-0", -1, "flat",
+        [(m.peer_id.hex(), float(m.weight)) for m in full.members], {},
+    )
+    for m in full.members:
+        if m.peer_id.hex() != signer:
+            assert receipt.witness[m.peer_id.hex()].samples == m.weight
+
+
+# ------------------------------------------------------- hostile inputs
+
+
+def _ledger_row(t, step, peers):
+    folded = fold_ledger(None, peers, [], now=t)
+    return {"t": folded["t"], "step": step, "ledger": folded}
+
+
+def test_contributions_jammed_and_truncated_jsonl(tmp_path):
+    """Two writer-jammed rows on one line are salvaged object-by-object;
+    a torn final line yields the last COMPLETE fold."""
+    row1 = _ledger_row(1000.0, 0, [_claim(samples=100, time=999.0)])
+    row2 = _ledger_row(2000.0, 1, [_claim(samples=250, time=1999.0)])
+    path = tmp_path / "ledger.jsonl"
+    torn = json.dumps(_ledger_row(3000.0, 2, [_claim(samples=999)]))
+    path.write_text(
+        json.dumps(row1) + json.dumps(row2) + "\n" + torn[: len(torn) // 2]
+    )
+    doc = runlog_summary.contributions_data(
+        runlog_summary.load_jsonl_rows([str(path)])
+    )
+    assert doc["source"] == "recorded"
+    # last COMPLETE state wins: the torn 999-sample row never surfaces
+    assert doc["leaderboard"][0]["claimed_samples"] == 250
+    assert doc["discrepancies"] == 0
+
+
+def test_contributions_pre_ledger_peers_kept_no_false_flags(tmp_path):
+    """A fleet with claims but NO receipts anywhere (pre-ledger builds):
+    every row is kept, credited as claimed, flagged by a coverage note —
+    and there are ZERO false discrepancies."""
+    path = tmp_path / "events.jsonl"
+    with path.open("w") as f:
+        for i in range(3):
+            f.write(json.dumps({
+                "t": 1000.0 + i, "event": "ledger.claim",
+                "peer": f"p{i:02d}", "samples": 64 * (i + 1), "rounds": 2,
+                "train_seconds": 30.0, "bytes_served": 0,
+            }) + "\n")
+    doc = runlog_summary.contributions_data(
+        runlog_summary.load_jsonl_rows([str(path)])
+    )
+    assert doc["source"] == "replayed"
+    assert len(doc["leaderboard"]) == 3
+    assert all(e["coverage"] == "pre-ledger" for e in doc["leaderboard"])
+    assert all(e["discrepancy"] is None for e in doc["leaderboard"])
+    assert doc["discrepancies"] == 0
+    assert any("predate receipts" in n for n in doc["notes"])
+
+
+def test_contributions_malformed_events_dropped_with_note(tmp_path):
+    path = tmp_path / "events.jsonl"
+    with path.open("w") as f:
+        f.write(json.dumps({
+            "t": 1000.0, "event": "ledger.claim", "peer": "good",
+            "samples": 64, "rounds": 2, "train_seconds": 30.0,
+            "bytes_served": 0,
+        }) + "\n")
+        f.write(json.dumps({
+            "t": 1001.0, "event": "ledger.claim", "peer": "evil",
+            "samples": -5, "rounds": 2, "train_seconds": 30.0,
+            "bytes_served": 0,
+        }) + "\n")
+        f.write(json.dumps({
+            "t": 1002.0, "event": "ledger.receipt", "signer": "x",
+            "members": ["x"], "weights": [], "witness": {},
+        }) + "\n")
+    doc = runlog_summary.contributions_data(
+        runlog_summary.load_jsonl_rows([str(path)])
+    )
+    assert [e["peer"] for e in doc["leaderboard"]] == ["good"]
+    assert any("malformed" in n for n in doc["notes"])
+
+
+def test_contributions_empty_swarm_exits_helpfully(tmp_path):
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    with pytest.raises(SystemExit) as exc:
+        runlog_summary.contributions_data(
+            runlog_summary.load_jsonl_rows([str(empty)])
+        )
+    assert "no contribution-ledger records" in str(exc.value)
+    # metrics-era rows (no ledger anything) get the same guidance
+    metrics = tmp_path / "metrics.jsonl"
+    metrics.write_text(json.dumps({"step": 1, "loss": 2.0}) + "\n")
+    with pytest.raises(SystemExit) as exc:
+        runlog_summary.contributions_data(
+            runlog_summary.load_jsonl_rows([str(metrics)])
+        )
+    assert "pre-ledger" in str(exc.value)
+
+
+def test_ledger_brief_quiet_without_ledger_rows(capsys):
+    swarm_watch.ledger_brief([{"step": 1, "loss": 2.0}])
+    assert capsys.readouterr().out == ""
+
+
+# ----------------------------------------------- coordinator fold wiring
+
+
+def test_coordinator_prev_ledger_restart_safe(tmp_path):
+    from dedloc_tpu.roles.coordinator import _prev_ledger
+
+    path = tmp_path / "coordinator_ledger.jsonl"
+    assert _prev_ledger(str(path)) is None  # not-yet-created log
+    row = _ledger_row(1000.0, 3, [_claim(samples=100)])
+    torn = json.dumps(_ledger_row(2000.0, 4, [_claim(samples=500)]))
+    path.write_text(json.dumps(row) + "\n" + torn[: len(torn) // 2])
+    prev = _prev_ledger(str(path))
+    assert prev is not None
+    assert prev["peers"]["aa" * 16]["claimed_samples"] == 100

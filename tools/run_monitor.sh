@@ -14,6 +14,7 @@ echo "=== $(date +%T) $RUN ==="
 tail -2 "$RUN/orchestrator.log" 2>/dev/null
 PYTHONPATH="$REPO" python "$REPO/tools/swarm_watch.py" --brief \
   --train-log "$RUN/train_log_tpu.jsonl" \
-  "$RUN/coordinator_metrics.jsonl" 2>/dev/null
+  "$RUN/coordinator_metrics.jsonl" \
+  "$RUN/coordinator_ledger.jsonl" 2>/dev/null
 PYTHONPATH="$REPO" python "$REPO/tools/participation_summary.py" "$RUN" 2>/dev/null | python -c "import json,sys; d=json.load(sys.stdin); print({k: d[k] for k in d if 'particip' in k or k=='group_hist'})"
 pgrep -fc "dedloc_tpu.roles" | xargs echo "live role processes:"

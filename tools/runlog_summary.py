@@ -9,6 +9,7 @@ Usage:
     python tools/runlog_summary.py --steps events.jsonl [...]
     python tools/runlog_summary.py --twin events.jsonl [...]
     python tools/runlog_summary.py --incidents coordinator_metrics.jsonl [...]
+    python tools/runlog_summary.py --contributions coordinator_ledger.jsonl [...]
 
 Any view also accepts ``--json``: one machine-readable JSON document on
 stdout (schema: the ``*_data`` builders below, each tagged with a
@@ -61,6 +62,16 @@ against its rolling baseline, open/close fold indices, and the
 attribution chain: offending peer and/or directed link, dominant step
 phase, and the representative slow round's trace id (feed it to
 ``--trace``). Reading guide in docs/observability.md.
+
+``--contributions`` renders the volunteer leaderboard from the signed
+contribution ledger (``dedloc_tpu/telemetry/ledger.py``): per-peer credited
+vs claimed samples (credited = min(claimed, receipt-supported x slack)),
+share of swarm, rounds, checkpoint/state bytes served, and any per-peer
+discrepancy the receipt fold flagged. Accepts the coordinator's durable
+ledger JSONL (recorded folds, last state wins) or per-peer telemetry event
+logs (``ledger.claim``/``ledger.receipt`` events — refolded through the
+same schemas and fold the coordinator runs). Reading guide in
+docs/observability.md; the discrepancy runbook is docs/fleet.md.
 
 ``--steps`` renders the step-phase flight recorder's view (per-step
 ``step.record`` / ``step.phase`` events from ``telemetry/steps.py``, or a
@@ -1340,6 +1351,174 @@ def print_incidents(all_rows):
         print(f"coverage note: {note}")
 
 
+def contributions_data(all_rows):
+    """The --contributions view as one JSON-able document: the volunteer
+    leaderboard. Coordinator ledger JSONL input renders the RECORDED fold
+    (rows with a ``ledger`` state; the last one wins — folds are
+    cumulative); telemetry event-log input REBUILDS the fold from
+    ``ledger.claim``/``ledger.receipt`` events through the SAME pydantic
+    schemas and ``fold_ledger`` the coordinator runs. Both paths are
+    deterministic for fixed inputs, so replaying a dumped ledger JSONL
+    reproduces the leaderboard bit-identically."""
+    _repo_on_path()
+    from dedloc_tpu.telemetry.ledger import fold_ledger, leaderboard
+
+    notes = []
+    ledger = None
+    source = "recorded"
+    for r in all_rows:
+        if isinstance(r.get("ledger"), dict):
+            ledger = r["ledger"]  # last recorded fold wins (cumulative)
+    if ledger is None:
+        from dedloc_tpu.telemetry.ledger import (
+            ContributionClaim,
+            RoundReceipt,
+        )
+
+        # last event per peer wins: both record families are cumulative,
+        # and a peer's ring buffer may have evicted its early events
+        claims_raw, receipts_raw = {}, {}
+        for r in all_rows:
+            name = r.get("event")
+            if name == ev.LEDGER_CLAIM and r.get("peer"):
+                prev = claims_raw.get(r["peer"])
+                if prev is None or (
+                    float(r.get("t", 0.0)) >= float(prev.get("t", 0.0))
+                ):
+                    claims_raw[r["peer"]] = r
+            elif name == ev.LEDGER_RECEIPT and r.get("signer"):
+                prev = receipts_raw.get(r["signer"])
+                if prev is None or (
+                    float(r.get("t", 0.0)) >= float(prev.get("t", 0.0))
+                ):
+                    receipts_raw[r["signer"]] = r
+        if not claims_raw and not receipts_raw:
+            sys.exit(
+                "no contribution-ledger records found — feed the "
+                "coordinator's ledger JSONL (rows with a 'ledger' fold) "
+                "or per-peer telemetry event logs carrying ledger.claim/"
+                "ledger.receipt events. A pre-ledger swarm emits neither: "
+                "upgrade the peers (or enable --optimizer ledger_claims) "
+                "and re-collect."
+            )
+        claims, receipts, dropped = [], [], 0
+        for r in claims_raw.values():
+            try:
+                claims.append(ContributionClaim.model_validate({
+                    "peer": r.get("peer"),
+                    "samples": r.get("samples"),
+                    "rounds": r.get("rounds"),
+                    "train_seconds": r.get("train_seconds"),
+                    "bytes_served": r.get("bytes_served"),
+                    "time": float(r.get("t", 0.0)),
+                }))
+            except Exception:  # noqa: BLE001 — malformed event row
+                dropped += 1
+        for r in receipts_raw.values():
+            try:
+                receipts.append(RoundReceipt.model_validate({
+                    "signer": r.get("signer"),
+                    "round_id": r.get("round_id"),
+                    "step": r.get("step"),
+                    "leg": r.get("leg"),
+                    "members": r.get("members"),
+                    "weights": r.get("weights"),
+                    "witness": r.get("witness") or {},
+                    "time": float(r.get("t", 0.0)),
+                }))
+            except Exception:  # noqa: BLE001 — malformed event row
+                dropped += 1
+        if dropped:
+            notes.append(
+                f"{dropped} malformed ledger event(s) dropped by schema "
+                "re-validation"
+            )
+        if not claims and not receipts:
+            sys.exit(
+                "every collected ledger event failed schema validation — "
+                "the logs are jammed or from an incompatible version"
+            )
+        # deterministic fold stamp: the newest record's time, never the
+        # reader's wall clock (replay bit-identity is the contract)
+        times = [c.time for c in claims] + [r.time for r in receipts]
+        ledger = fold_ledger(
+            None, claims, receipts, now=max(times) if times else 0.0
+        )
+        source = "replayed"
+    board = leaderboard(ledger)
+    pre = sum(1 for e in board if e.get("coverage") == "pre-ledger")
+    if pre:
+        notes.append(
+            f"{pre} peer(s) predate receipts (no receipt exists anywhere) "
+            "— credited as claimed, not checkable yet"
+        )
+    stale = sum(1 for e in board if e.get("coverage") == "stale")
+    if stale:
+        notes.append(
+            f"{stale} peer(s) carry a stale entry (records expired since "
+            "their last fold)"
+        )
+    return {
+        "view": "contributions",
+        "source": source,
+        "t": ledger.get("t"),
+        "slack": ledger.get("slack"),
+        "claims": ledger.get("claims"),
+        "receipt_signers": ledger.get("receipt_signers"),
+        "total_credited_samples": ledger.get("total_credited_samples"),
+        "discrepancies": ledger.get("discrepancies"),
+        "leaderboard": board,
+        "notes": notes,
+    }
+
+
+def _fmt_bytes_served(n):
+    n = float(n or 0)
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024 or unit == "GB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+
+
+def print_contributions(all_rows):
+    doc = contributions_data(all_rows)
+    slack = doc.get("slack")
+    print(
+        f"volunteer leaderboard ({doc['source']}): "
+        f"{len(doc['leaderboard'])} peer(s), "
+        f"{doc['discrepancies']} discrepancy(ies)"
+        + (f", over-claim slack x{slack}" if slack is not None else "")
+    )
+    print(
+        f"{'#':>3} {'peer':<14} {'credited':>10} {'claimed':>10} "
+        f"{'share':>6} {'rounds':>6} {'served':>9}  coverage"
+    )
+    for i, e in enumerate(doc["leaderboard"], 1):
+        peer = str(e.get("peer") or "?")
+        short = peer[:12] + ".." if len(peer) > 14 else peer
+        disc = e.get("discrepancy") or {}
+        flag = ""
+        if disc:
+            flag = f"  !! {disc.get('kind', 'discrepancy').upper()}"
+            if disc.get("ratio"):
+                flag += f" x{disc['ratio']}"
+        print(
+            f"{i:>3} {short:<14} {e['credited_samples']:>10} "
+            f"{e['claimed_samples']:>10} "
+            f"{e['share'] * 100:>5.1f}% {e['credited_rounds']:>6} "
+            f"{_fmt_bytes_served(e['bytes_served']):>9}  "
+            f"{e.get('coverage') or '?'}{flag}"
+        )
+    if doc["discrepancies"]:
+        print(
+            "\ndiscrepancies: credited = min(claimed, receipt-supported x "
+            "slack) — the runbook is docs/fleet.md \"reading the "
+            "leaderboard\""
+        )
+    for note in doc["notes"]:
+        print(f"coverage note: {note}")
+
+
 def trainlog_data(rows, requested):
     """The default (train_log) view as one JSON-able document."""
     by_step = {r["step"]: r for r in rows}
@@ -1427,6 +1606,18 @@ def main(argv):
             )
         rows = load_jsonl_rows(argv[1:])
         emit(incidents_data(rows)) if as_json else print_incidents(rows)
+        return
+    if argv and argv[0] == "--contributions":
+        if not argv[1:]:
+            sys.exit(
+                "usage: runlog_summary.py --contributions "
+                "coordinator_ledger.jsonl | events.jsonl [...]"
+            )
+        rows = load_jsonl_rows(argv[1:])
+        if as_json:
+            emit(contributions_data(rows))
+        else:
+            print_contributions(rows)
         return
     rows = load(argv[0])
     if not rows:

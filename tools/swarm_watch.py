@@ -26,9 +26,11 @@ Usage::
     python tools/swarm_watch.py --recommend coordinator.jsonl peer-*.jsonl
 
     # compact one-screen health check (tools/run_monitor.sh delegates
-    # here); missing files are skipped, not fatal
+    # here); missing files are skipped, not fatal. When a contribution
+    # ledger JSONL is among the inputs, one extra line names the top
+    # credited contributor and any discrepancy-flagged peers.
     python tools/swarm_watch.py --brief --train-log train_log.jsonl \
-        coordinator_metrics.jsonl
+        coordinator_metrics.jsonl coordinator_ledger.jsonl
 
 Input tolerance: everything loads through the shared hardened JSONL
 loader (``runlog_summary.load_jsonl_rows``) — jammed lines are split,
@@ -225,6 +227,42 @@ def print_watch(summary: dict, brief: bool = False) -> None:
         print(f"coverage note: {note}")
 
 
+def ledger_brief(rows) -> None:
+    """One line for ``--brief``: top credited contributor + discrepancy
+    flags, from any contribution-ledger fold rows among the inputs (the
+    coordinator's ``coordinator_ledger.jsonl``, or a simulator dump's
+    ``ledger.jsonl``). Last fold wins — the state is cumulative. Quiet
+    when there are none (a pre-ledger fleet): the brief stays one screen.
+    The full table is ``runlog_summary --contributions``."""
+    ledger = None
+    for r in rows:
+        if isinstance(r, dict) and isinstance(r.get("ledger"), dict):
+            ledger = r["ledger"]
+    if ledger is None:
+        return
+    from dedloc_tpu.telemetry.ledger import leaderboard
+
+    board = leaderboard(ledger)
+    if not board:
+        return
+    top = board[0]
+    peer = str(top.get("peer") or "?")[:12]
+    flagged = [
+        str(e.get("peer") or "?")[:12] for e in board if e.get("discrepancy")
+    ]
+    line = (
+        f"ledger: top {peer} ({top['credited_samples']} credited, "
+        f"{top['share'] * 100:.0f}% of {len(board)} peer(s))"
+    )
+    if flagged:
+        shown = ", ".join(flagged[:3])
+        more = f" +{len(flagged) - 3}" if len(flagged) > 3 else ""
+        line += f"; {len(flagged)} discrepancy(ies): {shown}{more}"
+    else:
+        line += "; no discrepancies"
+    print(line)
+
+
 def train_log_brief(path: str) -> None:
     """The last-step/cadence lines tools/run_monitor.sh used to compute
     with inline python — now one implementation, shared."""
@@ -373,6 +411,10 @@ def main(argv=None) -> int:
     from dedloc_tpu.telemetry.watch import watch_rows
 
     rows = load_jsonl_rows(paths)
+    if args.brief:
+        # one contribution-ledger line when ledger folds are among the
+        # inputs (run_monitor.sh passes the whole run directory's logs)
+        ledger_brief(rows)
     watch = watch_rows(rows)
     if watch.coverage["folds"] == 0:
         # the coordinator's own incident JSONL (recorded transitions, no
