@@ -304,6 +304,19 @@ class DecentralizedAverager:
                     self.peer_id = peer_id_from_public_key(
                         authorizer.local_public_key
                     )
+                elif self.signed_subkey and bytes(
+                    self.signed_subkey
+                ).startswith(b"rsa:"):
+                    # open runs with a record-signing key: derive the peer
+                    # id from the SAME key digest gated runs use, so this
+                    # peer's signed ledger records bind to its identity
+                    # (telemetry/ledger.subkey_owner_id)
+                    from dedloc_tpu.core.auth import peer_id_from_public_key
+                    from dedloc_tpu.dht.validation import OWNER_PREFIX
+
+                    self.peer_id = peer_id_from_public_key(
+                        bytes(self.signed_subkey)[len(OWNER_PREFIX):]
+                    )
                 else:
                     self.peer_id = node.node_id.to_bytes()
                 if client_mode and relay:
@@ -1430,6 +1443,21 @@ class DecentralizedAverager:
 
     # ------------------------------------------------ contribution ledger
 
+    def _ledger_subkey(self) -> bytes:
+        """The slot this peer's ledger records ride: the signed owner tag
+        when it speaks for this peer's id (subkey_owner_id — always true
+        for roles-built peers, whose validator key IS the identity key),
+        else the raw peer id, which binds structurally. Either way the
+        coordinator's parse path can verify the record speaks for exactly
+        this peer; a subkey that binds to somebody else would get every
+        record silently dropped at the fold."""
+        from dedloc_tpu.telemetry.ledger import subkey_owner_id
+
+        sk = self.signed_subkey
+        if sk is not None and subkey_owner_id(sk) == self.peer_id.hex():
+            return sk
+        return self.peer_id
+
     def _emit_receipt(self, group: GroupInfo, round_id: str,
                       leg: str) -> None:
         """Countersign a finalized round: fold the group's declared weights
@@ -1450,8 +1478,7 @@ class DecentralizedAverager:
                 member_weights, self._ledger_witness,
             )
             publish_receipt(
-                self.dht, self.prefix, self.signed_subkey or self.peer_id,
-                receipt,
+                self.dht, self.prefix, self._ledger_subkey(), receipt,
             )
             tele = telemetry.resolve(self.telemetry)
             if tele is not None:
@@ -1498,8 +1525,8 @@ class DecentralizedAverager:
                 time=get_dht_time(),
             )
             publish_claim(
-                self.dht, self.prefix, self.signed_subkey or self.peer_id,
-                claim, expiration=expiration,
+                self.dht, self.prefix, self._ledger_subkey(), claim,
+                expiration=expiration,
             )
         except Exception as e:  # noqa: BLE001 — accounting must never
             # cost a training step

@@ -69,7 +69,14 @@ def run_aux(
     # joins; gated joiners reject unsigned leader replies)
     authorizer, authority_public_key = build_authorizer(args)
     tx = build_optimizer(args)
-    dht, _public_key = build_dht(args)
+    # gated: record-sign with the token key, so the signed subkey digests
+    # to this peer's verified identity (ledger binding, roles/common.py)
+    dht, _public_key = build_dht(
+        args,
+        private_key=(
+            authorizer.local_private_key if authorizer is not None else None
+        ),
+    )
     logger.info(f"aux peer DHT listening on {dht.port}")
     # swarm telemetry (--telemetry.*, docs/observability.md): an aux donor's
     # join failures / allreduce stragglers are exactly the events operators

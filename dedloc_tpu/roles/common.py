@@ -163,9 +163,22 @@ def build_authorizer(args: CollaborationArguments):
     return authorizer, authorizer.authority_public_key
 
 
-def build_dht(args: CollaborationArguments, client_mode: Optional[bool] = None):
-    """DHT with the signed-metrics validator chain. Returns (dht, subkey)."""
-    validators, public_key = make_validators(args.dht.experiment_prefix)
+def build_dht(
+    args: CollaborationArguments,
+    client_mode: Optional[bool] = None,
+    private_key=None,
+):
+    """DHT with the signed-metrics validator chain. Returns (dht, subkey).
+
+    ``private_key`` lets a gated peer sign DHT records with its TOKEN key
+    (pass ``authorizer.local_private_key``): the owner-tag subkey then
+    digests to the same peer id matchmaking verified from the token, so
+    contribution-ledger records are identity-bound end to end
+    (telemetry/ledger.subkey_owner_id). Open runs leave it None and get a
+    fresh per-process key."""
+    validators, public_key = make_validators(
+        args.dht.experiment_prefix, private_key
+    )
     dht = DHT(
         initial_peers=args.dht.initial_peers,
         start=True,

@@ -496,14 +496,34 @@ def _fetch_ledger_records(dht, prefix: str) -> tuple:
     )
 
 
+def _ledger_substance(folded: dict) -> tuple:
+    """The fold minus its ever-ticking fields, for change detection: each
+    ~30s claim refresh bumps ``last_claim_t``/``train_seconds`` even in a
+    live-but-idle swarm, so comparing full per-peer entries would append a
+    cumulative ledger row on nearly every tick. Credited/claimed totals,
+    rounds, serve bytes, coverage and discrepancies are what a new row is
+    FOR — timestamps alone are not."""
+    peers = {
+        p: {
+            k: v
+            for k, v in e.items()
+            if k not in ("last_claim_t", "train_seconds")
+        }
+        for p, e in (folded.get("peers") or {}).items()
+        if isinstance(e, dict)
+    }
+    return (peers, folded.get("claims"), folded.get("receipt_signers"))
+
+
 def _ledger_fold(dht, prefix: str, extra, ledger_state, t, step) -> None:
     """One contribution-ledger fold inline in the coordinator loop: fetch
     the live claim/receipt records, fold them against the previous state
     (telemetry/ledger.fold_ledger), append the cumulative result to the
     durable ledger JSONL, and surface each NEWLY-flagged per-peer
     discrepancy as a ``watch.ledger`` telemetry event + warning. A fold
-    that changes nothing but its timestamp is not re-appended, so an idle
-    swarm does not grow the log."""
+    that changes nothing of substance (``_ledger_substance`` — fold
+    timestamps and per-claim refresh stamps excluded) is not re-appended,
+    so neither an idle swarm nor a live-but-idle one grows the log."""
     from dedloc_tpu.telemetry.ledger import fold_ledger
 
     try:
@@ -518,9 +538,8 @@ def _ledger_fold(dht, prefix: str, extra, ledger_state, t, step) -> None:
     folded = fold_ledger(
         prev, claims, receipts, slack=extra.ledger_slack, now=t
     )
-    changed = prev is None or any(
-        folded.get(k) != prev.get(k)
-        for k in ("peers", "claims", "receipt_signers")
+    changed = prev is None or (
+        _ledger_substance(folded) != _ledger_substance(prev)
     )
     ledger_state["prev"] = folded
     if changed:

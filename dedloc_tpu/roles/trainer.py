@@ -153,7 +153,14 @@ def run_trainer(args: CollaborationArguments) -> TrainState:
         moe_aux_weight=args.training.moe_aux_weight,
     )
     tx = build_optimizer(args)
-    dht, public_key = build_dht(args)
+    # gated: record-sign with the token key, so the signed subkey digests
+    # to this peer's verified identity (ledger binding, roles/common.py)
+    dht, public_key = build_dht(
+        args,
+        private_key=(
+            authorizer.local_private_key if authorizer is not None else None
+        ),
+    )
     logger.info(f"trainer DHT listening on {dht.port}")
     # swarm telemetry (--telemetry.*, docs/observability.md): disabled
     # (default) => None and the instrumented seams stay free

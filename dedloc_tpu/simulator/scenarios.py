@@ -1797,9 +1797,13 @@ async def _scenario_ledger(run: ScenarioRun) -> None:
                     for p, e in receipt.witness.items()
                 },
             )
+            # subkey = the peer id itself: the structural binding
+            # parse_receipts enforces (a record's signer must be the
+            # identity its slot speaks for, telemetry/ledger.py)
             await peer.node.store(
                 receipts_key(prefix).encode(), receipt.model_dump(),
-                get_dht_time() + 3600.0, subkey=peer.label.encode(),
+                get_dht_time() + 3600.0,
+                subkey=peer.node.node_id.to_bytes(),
             )
         # cumulative claims (last-write-wins per peer, like the one signed
         # subkey slot production enforces); the inflator multiplies its
@@ -1830,7 +1834,8 @@ async def _scenario_ledger(run: ScenarioRun) -> None:
             )
             await peer.node.store(
                 ledger_key(prefix).encode(), claim.model_dump(),
-                get_dht_time() + 3600.0, subkey=peer.label.encode(),
+                get_dht_time() + 3600.0,
+                subkey=peer.node.node_id.to_bytes(),
             )
         # coordinator-shaped fold off the live DHT view, through the SAME
         # parse + fold path roles/coordinator.py runs

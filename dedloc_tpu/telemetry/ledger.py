@@ -32,6 +32,16 @@ Both record families are cumulative by construction: an RSA-validated
 subkey must be exactly the owner tag (dht/validation.py), so each peer has
 ONE slot per family and every store is a last-write-wins refresh — there
 is no per-round record to garbage-collect.
+
+Identity binding: the ``peer``/``signer`` field inside a record is only
+trusted when it matches the identity its storage slot speaks for
+(``subkey_owner_id``): an RSA owner-tag subkey binds to the key digest
+gated matchmaking already uses as the peer id
+(core/auth.peer_id_from_public_key), a raw-bytes subkey binds to itself.
+``parse_claims``/``parse_receipts`` DROP any record that fails the
+binding, so a peer cannot publish, under its own valid slot, a claim
+naming a victim or a receipt whose fabricated ``signer`` launders a
+witness table crediting itself.
 """
 from __future__ import annotations
 
@@ -41,7 +51,9 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from pydantic import BaseModel, StrictInt, StrictStr, model_validator
 
+from dedloc_tpu.core.auth import peer_id_from_public_key
 from dedloc_tpu.core.timeutils import get_dht_time
+from dedloc_tpu.dht.validation import OWNER_PREFIX
 from dedloc_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -130,8 +142,8 @@ class RoundReceipt(BaseModel):
     identity); delegates in hierarchical mode countersign their clique's
     SUM leg (``leg="clique"``)."""
 
-    signer: StrictStr  # hex peer id (must equal the record's signed subkey
-    # owner in spirit; parse_receipts drops signer/membership mismatches)
+    signer: StrictStr  # hex peer id; parse_receipts drops any record
+    # whose signer does not match its storage slot (subkey_owner_id)
     round_id: StrictStr
     step: StrictInt  # optimizer step parsed from the round id (-1 unknown)
     leg: StrictStr  # flat | gossip | clique
@@ -198,29 +210,73 @@ def publish_receipt(dht, prefix: str, subkey: bytes,
     )
 
 
+def subkey_owner_id(subkey) -> Optional[str]:
+    """The ONE peer id a ledger record stored under ``subkey`` may speak
+    for. An RSA owner tag (dht/validation.py: the only subkey shape whose
+    writes are signature-checked at storing nodes) binds cryptographically
+    to the key-digest id gated matchmaking already enforces as the peer
+    identity (core/auth.peer_id_from_public_key). A raw-bytes subkey binds
+    structurally to itself — the open-swarm trust model, where node ids
+    are free and unsigned slots are writable by anyone. None = unbindable
+    shape; callers must drop the record."""
+    if isinstance(subkey, str):
+        subkey = subkey.encode()
+    if not isinstance(subkey, (bytes, bytearray)):
+        return None
+    subkey = bytes(subkey)
+    if subkey.startswith(OWNER_PREFIX):
+        try:
+            return peer_id_from_public_key(subkey[len(OWNER_PREFIX):]).hex()
+        except Exception:  # noqa: BLE001 — undigestible tag
+            return None
+    return subkey.hex()
+
+
 def parse_claims(entry_items) -> List[ContributionClaim]:
     """THE one parsing path for claim records: drop anything that fails
     the schema (defense in depth — a storing node that predates the schema
-    may have accepted garbage). ``entry_items`` iterates (subkey, unpacked
-    claim dict)."""
+    may have accepted garbage) and anything whose ``peer`` does not match
+    the identity its subkey speaks for (``subkey_owner_id``) — a peer
+    cannot publish a claim naming somebody else under its own slot.
+    ``entry_items`` iterates (subkey, unpacked claim dict)."""
     out: List[ContributionClaim] = []
-    for _sk, value in entry_items:
+    for sk, value in entry_items:
         try:
-            out.append(ContributionClaim.model_validate(value))
+            claim = ContributionClaim.model_validate(value)
         except Exception as e:  # noqa: BLE001 — malformed claim
             logger.debug(f"dropping malformed claim record: {e!r}")
             continue
+        owner = subkey_owner_id(sk)
+        if owner != claim.peer:
+            logger.debug(
+                f"dropping claim for {claim.peer!r}: its slot speaks for "
+                f"{owner!r}"
+            )
+            continue
+        out.append(claim)
     return out
 
 
 def parse_receipts(entry_items) -> List[RoundReceipt]:
+    """Same hardening for receipts: a record whose ``signer`` is not the
+    identity its subkey speaks for is DROPPED before the fold ever sees
+    its witness table — otherwise a peer could countersign its own work
+    under a fabricated signer id and bypass the self-witness skip."""
     out: List[RoundReceipt] = []
-    for _sk, value in entry_items:
+    for sk, value in entry_items:
         try:
-            out.append(RoundReceipt.model_validate(value))
+            receipt = RoundReceipt.model_validate(value)
         except Exception as e:  # noqa: BLE001 — malformed receipt
             logger.debug(f"dropping malformed receipt record: {e!r}")
             continue
+        owner = subkey_owner_id(sk)
+        if owner != receipt.signer:
+            logger.debug(
+                f"dropping receipt signed {receipt.signer!r}: its slot "
+                f"speaks for {owner!r}"
+            )
+            continue
+        out.append(receipt)
     return out
 
 
@@ -291,6 +347,15 @@ def fold_ledger(prev: Optional[Dict[str, Any]],
     fully supersedes its ``prev`` entry, and a peer whose records expired
     keeps its ``prev`` entry (with a coverage note) instead of vanishing.
 
+    Receipt support is MONOTONE for peers still in the view: receipts
+    expire (~300s) long before claims stop refreshing, so a long-running
+    peer whose former group-mates left would otherwise flip to
+    "unwitnessed" and lose all credit. The ``prev`` fold's
+    ``supported_samples``/``supported_rounds`` floor the current support
+    (both families are cumulative, so the max is sound); a peer covered
+    only by that carried floor is marked ``coverage="carried"`` — still
+    capped, never falsely flagged.
+
     Deterministic for fixed inputs: peers fold in sorted order and floats
     are rounded, so replaying a dumped ledger JSONL reproduces the state
     bit-identically (the acceptance bar)."""
@@ -308,12 +373,31 @@ def fold_ledger(prev: Optional[Dict[str, Any]],
             cur["samples"] = max(cur["samples"], float(entry.samples))
             cur["rounds"] = max(cur["rounds"], int(entry.rounds))
     have_receipts = bool(receipts)
+    prev_peers = dict((prev or {}).get("peers") or {})
+
+    def _floor(peer: str) -> Tuple[float, int]:
+        """Receipt support carried from the prev fold (0,0 when the peer
+        was never receipt-covered — pre-ledger entries carry None)."""
+        old = prev_peers.get(peer)
+        if not isinstance(old, dict):
+            return 0.0, 0
+        s = old.get("supported_samples")
+        if not isinstance(s, (int, float)):
+            return 0.0, 0
+        r = old.get("supported_rounds")
+        if not isinstance(r, (int, float)):
+            r = old.get("credited_rounds") or 0  # pre-field ledger rows
+        return float(s), int(r)
 
     peers: Dict[str, Dict[str, Any]] = {}
     for claim in sorted(claims, key=lambda c: (c.peer, -c.time)):
         if claim.peer in peers:
             continue  # first (latest) claim per peer wins
         sup = supported.get(claim.peer)
+        floor_s, floor_r = _floor(claim.peer)
+        eff_s = max(sup["samples"] if sup else 0.0, floor_s)
+        eff_r = max(sup["rounds"] if sup else 0, floor_r)
+        witnessed = sup is not None or floor_s > 0 or floor_r > 0
         entry: Dict[str, Any] = {
             "peer": claim.peer,
             "claimed_samples": int(claim.samples),
@@ -323,18 +407,20 @@ def fold_ledger(prev: Optional[Dict[str, Any]],
             "last_claim_t": round(float(claim.time), 3),
             "discrepancy": None,
         }
-        if not have_receipts:
-            # pre-ledger swarm: no receipts exist ANYWHERE, so there is no
-            # evidence to check claims against — credit as claimed, say so
+        if not have_receipts and not witnessed:
+            # pre-ledger swarm: no receipt evidence exists anywhere, now
+            # or in any prior fold — credit as claimed, say so
             entry["coverage"] = "pre-ledger"
             entry["supported_samples"] = None
+            entry["supported_rounds"] = None
             entry["credited_samples"] = int(claim.samples)
             entry["credited_rounds"] = int(claim.rounds)
-        elif sup is None:
-            # receipts exist but nobody witnessed this peer: a non-zero
-            # claim is unsupported — named, credited zero
+        elif not witnessed:
+            # receipts exist but nobody (current or prior fold) witnessed
+            # this peer: a non-zero claim is unsupported — named, zero
             entry["coverage"] = "unwitnessed"
             entry["supported_samples"] = 0.0
+            entry["supported_rounds"] = 0
             entry["credited_samples"] = 0
             entry["credited_rounds"] = 0
             if claim.samples > 0:
@@ -344,22 +430,22 @@ def fold_ledger(prev: Optional[Dict[str, Any]],
                     "supported_samples": 0.0,
                 }
         else:
-            cap = sup["samples"] * slack
+            cap = eff_s * slack
             credited = min(float(claim.samples), cap)
-            entry["coverage"] = "receipts"
-            entry["supported_samples"] = round(sup["samples"], 3)
+            entry["coverage"] = "receipts" if sup is not None else "carried"
+            entry["supported_samples"] = round(eff_s, 3)
+            entry["supported_rounds"] = int(eff_r)
             entry["credited_samples"] = int(round(credited))
             entry["credited_rounds"] = min(
-                int(claim.rounds), int(sup["rounds"] * slack) + 1
+                int(claim.rounds), int(eff_r * slack) + 1
             )
             if float(claim.samples) > cap:
                 entry["discrepancy"] = {
                     "kind": "overclaim",
                     "claimed_samples": int(claim.samples),
-                    "supported_samples": round(sup["samples"], 3),
+                    "supported_samples": round(eff_s, 3),
                     "ratio": round(
-                        float(claim.samples)
-                        / max(sup["samples"], 1e-9),
+                        float(claim.samples) / max(eff_s, 1e-9),
                         3,
                     ),
                 }
@@ -373,6 +459,9 @@ def fold_ledger(prev: Optional[Dict[str, Any]],
         sup = supported[peer]
         if sup["samples"] <= 0 and sup["rounds"] <= 0:
             continue
+        floor_s, floor_r = _floor(peer)
+        eff_s = max(sup["samples"], floor_s)
+        eff_r = max(int(sup["rounds"]), floor_r)
         peers[peer] = {
             "peer": peer,
             "claimed_samples": 0,
@@ -381,9 +470,10 @@ def fold_ledger(prev: Optional[Dict[str, Any]],
             "bytes_served": 0,
             "last_claim_t": None,
             "coverage": "receipts-only",
-            "supported_samples": round(sup["samples"], 3),
-            "credited_samples": int(round(sup["samples"])),
-            "credited_rounds": int(sup["rounds"]),
+            "supported_samples": round(eff_s, 3),
+            "supported_rounds": int(eff_r),
+            "credited_samples": int(round(eff_s)),
+            "credited_rounds": int(eff_r),
             "discrepancy": None,
         }
     # restart-safe carry-over: peers whose records expired keep their last

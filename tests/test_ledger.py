@@ -35,6 +35,7 @@ from dedloc_tpu.telemetry.ledger import (
     parse_round_step,
     receipt_from_group,
     receipts_key,
+    subkey_owner_id,
     update_witness,
 )
 
@@ -116,16 +117,87 @@ def test_receipt_schema_accepts_and_rejects():
 def test_parse_drops_malformed_keeps_valid():
     good = _claim().model_dump()
     claims = parse_claims([
-        (b"k1", good),
-        (b"k2", {"peer": "bb", "samples": -3}),  # malformed
-        (b"k3", "not a dict"),
+        (bytes.fromhex(good["peer"]), good),
+        (b"\xbb", {"peer": "bb", "samples": -3}),  # malformed
+        (b"\xcc", "not a dict"),
     ])
     assert [c.peer for c in claims] == [good["peer"]]
     receipts = parse_receipts([
-        (b"k1", _receipt().model_dump()),
-        (b"k2", {"signer": "aa"}),
+        (b"\xaa", _receipt().model_dump()),  # signer "aa" under its slot
+        (b"\xbb", {"signer": "bb"}),
     ])
     assert len(receipts) == 1
+
+
+# ------------------------------------------------- unit: identity binding
+
+
+def test_subkey_owner_id_binds_rsa_tag_and_raw_bytes():
+    from dedloc_tpu.core.auth import peer_id_from_public_key
+    from dedloc_tpu.dht.crypto import RSAPrivateKey
+    from dedloc_tpu.dht.validation import OWNER_PREFIX
+
+    key = RSAPrivateKey()
+    tag = OWNER_PREFIX + key.public_bytes()
+    assert subkey_owner_id(tag) == (
+        peer_id_from_public_key(key.public_bytes()).hex()
+    )
+    assert subkey_owner_id(b"\xaa\xbb") == "aabb"
+    assert subkey_owner_id(12345) is None  # unbindable shape
+
+
+def test_parse_claims_rejects_spoofed_peer():
+    """A claim naming a victim, published under the attacker's own slot,
+    never reaches the fold — the victim's totals cannot be overridden."""
+    victim = "aa" * 16
+    forged = _claim(samples=0, time=9999.0).model_dump()  # peer = victim
+    assert parse_claims([(b"\xee" * 16, forged)]) == []
+    # and the rsa owner tag binds through the key digest, both ways
+    from dedloc_tpu.core.auth import peer_id_from_public_key
+    from dedloc_tpu.dht.crypto import RSAPrivateKey
+    from dedloc_tpu.dht.validation import OWNER_PREFIX
+
+    key = RSAPrivateKey()
+    tag = OWNER_PREFIX + key.public_bytes()
+    me = peer_id_from_public_key(key.public_bytes()).hex()
+    ok = _claim(peer=me).model_dump()
+    assert [c.peer for c in parse_claims([(tag, ok)])] == [me]
+    assert parse_claims([(tag, _claim(peer=victim).model_dump())]) == []
+
+
+def test_parse_receipts_rejects_laundered_witness():
+    """The attack the binding exists for: a receipt published under the
+    attacker's OWN valid slot whose ``signer`` is a fabricated id and
+    whose witness table credits the attacker — without the binding, the
+    fold's self-witness skip (peer == signer) is bypassed and the
+    attacker's inflated claim becomes fully receipt-supported."""
+    attacker = "ee" * 16
+    fabricated = "ff" * 16
+    members = sorted([attacker, fabricated])
+    forged = RoundReceipt(
+        signer=fabricated, round_id="r0", step=-1, leg="flat",
+        members=members, weights=[1e9, 1e9],
+        witness={attacker: {"samples": 1e9, "rounds": 1}},
+        time=1000.0,
+    ).model_dump()
+    assert parse_receipts([(bytes.fromhex(attacker), forged)]) == []
+    # and folding what parse admits credits the attacker NOTHING
+    folded = fold_ledger(
+        None,
+        [_claim(peer=attacker, samples=10**9)],
+        parse_receipts([(bytes.fromhex(attacker), forged)]),
+        now=2000.0,
+    )
+    assert folded["peers"][attacker]["credited_samples"] == 10**9  # pre-
+    # ledger only because NO receipt survived; with any honest receipt
+    # present the attacker is unwitnessed:
+    honest = _receipt(signer="aa", members=["aa", "bb"],
+                      witness={"bb": {"samples": 32.0, "rounds": 1}})
+    folded = fold_ledger(
+        None, [_claim(peer=attacker, samples=10**9)], [honest], now=2000.0,
+    )
+    assert folded["peers"][attacker]["credited_samples"] == 0
+    assert folded["peers"][attacker]["discrepancy"]["kind"] == "unwitnessed"
 
 
 def test_parse_round_step():
@@ -252,6 +324,37 @@ def test_fold_prev_carryover_marked_stale():
     folded2 = fold_ledger(folded, [_claim(samples=600)], [], now=4000.0)
     assert folded2["peers"]["aa" * 16]["credited_samples"] == 600
     assert folded2["peers"]["aa" * 16]["coverage"] == "pre-ledger"
+
+
+def test_fold_receipt_expiry_carries_support_for_present_peers():
+    """Receipts expire (~300s) long before a long-running peer's claims
+    stop refreshing: the prev fold's supported totals floor the current
+    ones, so credit stays monotone — no flip to 0, no false
+    'unwitnessed' flag — while the cap still holds against inflation."""
+    receipt = _receipt(
+        signer="bb", members=["aa" * 16, "bb"], weights=[100.0, 100.0],
+        witness={"aa" * 16: {"samples": 100.0, "rounds": 5}},
+    )
+    first = fold_ledger(
+        None, [_claim(samples=100, rounds=5)], [receipt], now=2000.0,
+    )
+    assert first["peers"]["aa" * 16]["coverage"] == "receipts"
+    # all receipts expired; the peer is still present and claims on
+    second = fold_ledger(
+        first, [_claim(samples=110, rounds=5, time=2500.0)], [], now=3000.0,
+    )
+    entry = second["peers"]["aa" * 16]
+    assert entry["coverage"] == "carried"
+    assert entry["credited_samples"] == 110  # within slack of the floor
+    assert entry["supported_samples"] == 100.0
+    assert entry["discrepancy"] is None
+    # the carried floor still CAPS: inflation cannot ride the expiry
+    third = fold_ledger(
+        second, [_claim(samples=100000, time=2600.0)], [], now=4000.0,
+    )
+    entry = third["peers"]["aa" * 16]
+    assert entry["credited_samples"] == int(100 * DEFAULT_SLACK)
+    assert entry["discrepancy"]["kind"] == "overclaim"
 
 
 def test_fold_latest_claim_per_peer_wins():
@@ -559,6 +662,43 @@ def test_ledger_brief_quiet_without_ledger_rows(capsys):
 
 
 # ----------------------------------------------- coordinator fold wiring
+
+
+def test_coordinator_idle_claim_refresh_does_not_grow_log(
+    tmp_path, monkeypatch
+):
+    """A live-but-idle swarm re-publishes claims every ~30s with only the
+    timestamps moving: those folds must NOT append new ledger rows — only
+    a change of substance (totals, coverage, discrepancies) does."""
+    import types
+
+    from dedloc_tpu.roles import coordinator as co
+
+    feeds = iter([
+        ([_claim(samples=100, time=1000.0, train_seconds=60.0)], []),
+        # refresh tick: same totals, newer stamps only
+        ([_claim(samples=100, time=1030.0, train_seconds=90.0)], []),
+        # real progress: a new row is due
+        ([_claim(samples=200, time=1060.0, train_seconds=120.0)], []),
+    ])
+    monkeypatch.setattr(
+        co, "_fetch_ledger_records", lambda dht, prefix: next(feeds)
+    )
+    extra = types.SimpleNamespace(
+        ledger_slack=DEFAULT_SLACK,
+        ledger_log_path=str(tmp_path / "coordinator_ledger.jsonl"),
+    )
+    state = {"prev": None, "flagged": {}}
+    for i, t in enumerate((1000.0, 1030.0, 1060.0)):
+        co._ledger_fold(None, "exp", extra, state, t, i)
+    rows = [
+        json.loads(line)
+        for line in Path(extra.ledger_log_path).read_text().splitlines()
+    ]
+    assert len(rows) == 2  # the timestamp-only refresh appended nothing
+    assert rows[-1]["ledger"]["peers"]["aa" * 16]["claimed_samples"] == 200
+    # the in-memory prev still advanced to the freshest stamps
+    assert state["prev"]["peers"]["aa" * 16]["last_claim_t"] == 1060.0
 
 
 def test_coordinator_prev_ledger_restart_safe(tmp_path):
