@@ -758,6 +758,79 @@ def run_longctx() -> None:
     print(json.dumps(result))
 
 
+# the 1,000-peer mixed acceptance scenario's wall on this box class BEFORE
+# the virtual-time engine overhaul (timer wheel + sharded dispatch + lazy
+# hydration + DHT lookup cache) — the sim_engine bench's vs_baseline anchor
+# (SIMBENCH_r01.json records the pre/post pair)
+_PRE_OVERHAUL_MIXED1000_WALL_S = 21.765
+
+
+def run_sim_engine() -> None:
+    """Virtual-time engine bench (DEDLOC_BENCH=sim_engine): the 1,000-peer
+    mixed scenario at its DEFAULT spec — exactly what ``tools/swarm_sim.py
+    --scenario mixed --peers 1000 --seed 0`` runs, so the trajectory stays
+    comparable to the pre-overhaul measurement of the same command —
+    end-to-end on the discrete-event engine: one core, zero real sleeping.
+    The headline metric is timer events scheduled per wall second — the
+    engine's dispatch throughput, which is exactly what the timer wheel /
+    sharded dispatch / lazy hydration work moves. The event count is a
+    deterministic function of (seed, spec), so events/sec isolates engine
+    wall cost from workload drift, and it is higher-is-better as
+    tools/bench_gate.py requires (wall seconds would gate backwards).
+    vs_baseline is the pre-overhaul wall for this command on the same box
+    class over this run's wall: the engine speedup. Unless
+    DEDLOC_BENCH_TIMING=0, the record also carries the 10,000-peer diurnal
+    point (the planet-scale proof: 10k peers over 24 virtual hours in well
+    under a minute of wall).
+
+    DEDLOC_BENCH_TINY=1 shrinks the roster for a CI smoke; the metric name
+    carries the roster size so a smoke never gates against the full run.
+    """
+    import resource
+
+    from dedloc_tpu.simulator import scenarios as S
+    from dedloc_tpu.simulator.engine import SIM_EPOCH
+
+    tiny = os.environ.get("DEDLOC_BENCH_TINY", "") == "1"
+    timing = os.environ.get("DEDLOC_BENCH_TIMING", "1") != "0"
+    peers = 100 if tiny else 1000
+    spec = {"scenario": "mixed", "peers": peers, "seed": 0}
+    run = S.ScenarioRun(spec)
+    wall0 = time.perf_counter()
+    with run.engine:
+        run.engine.run(S.SCENARIOS["mixed"](run), timeout=36000.0)
+        events = run.engine.clock.sleeper_stats()["scheduled_total"]
+        virtual_s = run.engine.clock.offset - SIM_EPOCH
+        run.engine.run(run.swarm.shutdown())
+    run.engine.close()
+    wall = time.perf_counter() - wall0
+
+    result = {
+        "metric": f"sim_mixed{peers}_timer_events_per_wall_sec",
+        "value": round(events / wall, 1),
+        "unit": "events/sec",
+        "wall_s": round(wall, 3),
+        "virtual_s": round(virtual_s, 3),
+        "events_scheduled": events,
+        "peak_rss_mb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1
+        ),
+        "vs_baseline": (
+            1.0 if tiny  # smoke roster: no comparable pre-overhaul anchor
+            else round(_PRE_OVERHAUL_MIXED1000_WALL_S / wall, 2)
+        ),
+    }
+    if timing and not tiny:
+        d = S.run_scenario({"scenario": "diurnal", "peers": 10000, "seed": 0})
+        result["diurnal_10k"] = {
+            "wall_s": d["wall_s"],
+            "virtual_s": d["virtual_s"],
+            "peak_online": d["diurnal"]["peak_online"],
+            "get_success": d["diurnal"]["get_success"],
+        }
+    print(json.dumps(result))
+
+
 def main() -> None:
     if os.environ.get("DEDLOC_BENCH") == "codec":
         run_codec()
@@ -776,6 +849,9 @@ def main() -> None:
         return
     if os.environ.get("DEDLOC_BENCH") == "longctx":
         run_longctx()
+        return
+    if os.environ.get("DEDLOC_BENCH") == "sim_engine":
+        run_sim_engine()
         return
     from dedloc_tpu.models.albert import (
         AlbertConfig,

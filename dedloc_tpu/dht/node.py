@@ -27,8 +27,11 @@ def _pack_nodes(nodes: Sequence[NodeInfo]) -> List[List[Any]]:
 
 
 def _unpack_nodes(raw: Sequence[Sequence[Any]]) -> List[NodeInfo]:
+    # one clock read for the whole batch: the per-NodeInfo default_factory
+    # is a visible cost when a lookup-heavy simulation unpacks millions
+    now = timeutils.monotonic()
     return [
-        NodeInfo(DHTID.from_bytes(r[0]), (r[1], int(r[2]))) for r in raw
+        NodeInfo(DHTID.from_bytes(r[0]), (r[1], int(r[2])), now) for r in raw
     ]
 
 
@@ -55,6 +58,7 @@ class DHTNode:
         maintenance_interval: float = 30.0,  # 0 disables the background loop
         stale_peer_timeout: float = 75.0,
         bucket_refresh_interval: float = 120.0,
+        lookup_cache_ttl: float = 15.0,  # nearest-set cache: 0 disables
         replication_interval: float = 600.0,  # Kademlia-style, much slower
         # than eviction/refresh: a full lookup+store fan-out per held record
         # every 30s would be orders of magnitude more traffic than needed
@@ -77,6 +81,17 @@ class DHTNode:
         # would silently delay it on recently-booted hosts, where
         # monotonic() < replication_interval)
         self._last_replication: Optional[float] = None
+        # nearest-set lookup cache (classic Kademlia lookup caching): an
+        # iterative lookup's converged result for a target is stable for
+        # as long as the keyspace neighborhood is — repeated gets/stores
+        # on a hot key (matchmaking leader boards, catalog records) pay
+        # the iterative fan-out once per TTL instead of once per call.
+        # Entries are dropped early whenever a query against the cached
+        # set errors (a holder died — re-converge), so churn degrades to
+        # exactly the old behavior instead of serving a stale set.
+        self.lookup_cache_ttl = lookup_cache_ttl
+        self._nearest_cache: Dict[Tuple[int, int], Tuple[float, List[NodeInfo]]] = {}
+        self._sender_args_cache: Optional[Dict[str, Any]] = None
         self.routing_table = RoutingTable(self.node_id, bucket_size)
         self.storage = DHTLocalStorage()
         self.cache = DHTLocalStorage(maxsize=2000)
@@ -112,10 +127,16 @@ class DHTNode:
     # ------------------------------------------------------------------ RPCs
 
     def _sender_args(self) -> Dict[str, Any]:
-        return {
-            "sender_id": self.node_id.to_bytes(),
-            "sender_port": self.port,  # None in client mode
-        }
+        # node_id and port are fixed after create(); one RPC is issued per
+        # dict, so build it once (callers copy via ``{**...}`` or hand it
+        # straight to msgpack — nobody mutates it)
+        cached = self._sender_args_cache
+        if cached is None:
+            cached = self._sender_args_cache = {
+                "sender_id": self.node_id.to_bytes(),
+                "sender_port": self.port,  # None in client mode
+            }
+        return cached
 
     def _register_sender(self, peer: Endpoint, args: Dict[str, Any]) -> None:
         port = args.get("sender_port")
@@ -151,6 +172,9 @@ class DHTNode:
                 result["expiration"] = expiration
         return result
 
+    _rpc_ping.rpc_inline = True
+    _rpc_find.rpc_inline = True
+
     async def _rpc_store(self, peer: Endpoint, args: Dict[str, Any]) -> Dict[str, Any]:
         self._register_sender(peer, args)
         outcomes = []
@@ -165,6 +189,11 @@ class DHTNode:
             else:
                 outcomes.append(self.storage.store(key, value, expiration))
         return {"stored": outcomes}
+
+    # the core DHT handlers never await I/O (validation and storage are
+    # synchronous): the RPC server may run them inline instead of paying a
+    # Task per request (protocol.py ``rpc_inline``)
+    _rpc_store.rpc_inline = True
 
     # ----------------------------------------------------------- client side
 
@@ -191,14 +220,30 @@ class DHTNode:
     async def find_nearest_nodes(
         self, target: DHTID, k: Optional[int] = None
     ) -> List[NodeInfo]:
-        """Iterative Kademlia lookup over the `dht.find` RPC."""
+        """Iterative Kademlia lookup over the `dht.find` RPC. Results are
+        cached per (target, k) for ``lookup_cache_ttl`` virtual seconds;
+        callers that then observe a dead holder must ``_uncache_nearest``
+        so the next call re-converges."""
         k = k or self.bucket_size
+        cache_key = (int(target), k)
+        if self.lookup_cache_ttl > 0:
+            hit = self._nearest_cache.get(cache_key)
+            if hit is not None:
+                if hit[0] > get_dht_time():
+                    return list(hit[1])
+                del self._nearest_cache[cache_key]
         # a lookup IS refresh activity for the target's bucket
         self.routing_table.mark_range_refreshed(target)
         candidates: Dict[int, NodeInfo] = {
             n.node_id: n for n in self.routing_table.nearest_neighbors(target, k)
         }
         queried: set = set()
+        # nodes that failed a probe THIS lookup: a later reply must not
+        # re-admit one via setdefault — it is already in ``queried``, so the
+        # termination check would accept it into the final top-k and every
+        # subsequent get/store against the cached set would fail on it,
+        # evicting the cache and re-learning the same dead peer forever
+        failed: set = set()
         while True:
             frontier = sorted(
                 (n for nid, n in candidates.items() if nid not in queried),
@@ -225,13 +270,27 @@ class DHTNode:
                 if isinstance(reply, Exception):
                     self.routing_table.remove_node(node.node_id)
                     candidates.pop(node.node_id, None)
+                    failed.add(node.node_id)
                     continue
                 for info in _unpack_nodes(reply["nodes"]):
-                    if info.node_id != self.node_id:
+                    if info.node_id != self.node_id and info.node_id not in failed:
                         candidates.setdefault(info.node_id, info)
                         self.routing_table.add_or_update_node(info)
         out = sorted(candidates.values(), key=lambda n: n.node_id ^ target)
-        return out[:k]
+        out = out[:k]
+        if self.lookup_cache_ttl > 0:
+            while len(self._nearest_cache) >= 256:  # bounded: drop oldest
+                self._nearest_cache.pop(next(iter(self._nearest_cache)))
+            self._nearest_cache[cache_key] = (
+                get_dht_time() + self.lookup_cache_ttl, list(out)
+            )
+        return out
+
+    def _uncache_nearest(self, target: DHTID, k: Optional[int] = None) -> None:
+        """Drop the cached nearest set for ``target`` — called when a query
+        against it failed, i.e. the cached neighborhood no longer matches
+        the live network."""
+        self._nearest_cache.pop((int(target), k or self.bucket_size), None)
 
     async def store(
         self,
@@ -256,11 +315,10 @@ class DHTNode:
         # nearest set — a k=num_replicas lookup from a sparse table can
         # settle on a locally-nearest set that misses the real one, and
         # store/get would then disagree about where the record lives
-        nearest = (
-            await self.find_nearest_nodes(
-                key_id, k=max(self.bucket_size, self.num_replicas)
-            )
-        )[: self.num_replicas]
+        k_wide = max(self.bucket_size, self.num_replicas)
+        nearest = (await self.find_nearest_nodes(key_id, k=k_wide))[
+            : self.num_replicas
+        ]
         stored_anywhere = False
         # self-store if we are closer than the furthest replica (or low pop.)
         if not self.client_mode and (
@@ -285,8 +343,13 @@ class DHTNode:
             ),
             return_exceptions=True,
         )
-        for reply in replies:
-            if not isinstance(reply, Exception) and any(reply.get("stored", [])):
+        for node, reply in zip(nearest, replies):
+            if isinstance(reply, Exception):
+                # a replica died since the set was (possibly) cached —
+                # evict it everywhere so the next lookup re-converges
+                self.routing_table.remove_node(node.node_id)
+                self._uncache_nearest(key_id, k_wide)
+            elif any(reply.get("stored", [])):
                 stored_anywhere = True
         return stored_anywhere
 
@@ -312,11 +375,10 @@ class DHTNode:
         # wide lookup for the same reason as in store(); query a couple of
         # nodes beyond the replica count so one stale/missed replica does
         # not turn into a lost record
-        nearest = (
-            await self.find_nearest_nodes(
-                key_id, k=max(self.bucket_size, self.num_replicas)
-            )
-        )[: self.num_replicas + 2]
+        k_wide = max(self.bucket_size, self.num_replicas)
+        nearest = (await self.find_nearest_nodes(key_id, k=k_wide))[
+            : self.num_replicas + 2
+        ]
         replies = await asyncio.gather(
             *(
                 self.client.call(
@@ -333,8 +395,10 @@ class DHTNode:
             ),
             return_exceptions=True,
         )
-        for reply in replies:
+        for node, reply in zip(nearest, replies):
             if isinstance(reply, Exception):
+                self.routing_table.remove_node(node.node_id)
+                self._uncache_nearest(key_id, k_wide)
                 continue
             # validate on the READ path too: a malicious replica could serve
             # forged records it never accepted through _rpc_store

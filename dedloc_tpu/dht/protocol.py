@@ -61,6 +61,12 @@ def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
         )
 
 
+def _expire_response(fut: "asyncio.Future") -> None:
+    """Deadline callback for an in-flight RPC's response future."""
+    if not fut.done():
+        fut.set_exception(asyncio.TimeoutError("rpc response timed out"))
+
+
 Handler = Callable[[Endpoint, Dict[str, Any]], Awaitable[Any]]
 
 
@@ -232,6 +238,21 @@ class RPCServer:
                     # reply to a call_over we piped down this connection
                     self._route_reply(msg, writer)
                     continue
+                handler = self._handlers.get(msg.get("method"))
+                if (
+                    handler is not None
+                    and getattr(handler, "rpc_inline", False)
+                    and faults._active is None
+                ):
+                    # non-blocking handlers (marked ``rpc_inline``: they
+                    # never await I/O) run inline — task-per-request costs
+                    # a Task allocation and two context switches per RPC,
+                    # which dominates a lookup-heavy simulation. With a
+                    # fault schedule installed every request takes the
+                    # task path so ``delay`` faults cannot head-of-line
+                    # block an entire connection.
+                    await self._dispatch(peer, msg, writer)
+                    continue
                 # retained + exception-logged (utils/aio): a handler
                 # task dying silently would swallow the request forever
                 keep_task(self._dispatch(peer, msg, writer),
@@ -324,7 +345,15 @@ class RPCClient:
         self.nat = None
 
     async def _connect(self, endpoint: Endpoint):
-        lock = self._conn_locks.setdefault(endpoint, asyncio.Lock())
+        # fast path first: a pooled connection needs no lock (entries are
+        # installed fully-formed), and ``setdefault`` with an eagerly-built
+        # Lock() would allocate one per CALL, not one per endpoint
+        conn = self._conns.get(endpoint)
+        if conn is not None:
+            return conn
+        lock = self._conn_locks.get(endpoint)
+        if lock is None:
+            lock = self._conn_locks.setdefault(endpoint, asyncio.Lock())
         async with lock:
             if endpoint in self._conns:
                 return self._conns[endpoint]
@@ -582,11 +611,18 @@ class RPCClient:
         if tc is not None:
             request["tc"] = tc
         write_frame(writer, request)
+        # hand-rolled deadline instead of asyncio.wait_for: the response
+        # future is a bare Future (no task wrapping needed), so the whole
+        # timeout is one timer that fails the future — wait_for's
+        # ensure_future / release-waiter / cancellation-shield machinery
+        # is pure overhead on this, and this is the hottest await in a
+        # large simulation
+        deadline = asyncio.get_event_loop().call_later(
+            timeout or self.request_timeout, _expire_response, fut
+        )
         try:
             await writer.drain()
-            reply = await asyncio.wait_for(
-                fut, timeout=timeout or self.request_timeout
-            )
+            reply = await fut
         except (asyncio.TimeoutError, ConnectionError, OSError) as e:
             self._pending.get(endpoint, {}).pop(req_id, None)
             if tele is not None:
@@ -596,6 +632,8 @@ class RPCClient:
                     error=type(e).__name__,
                 )
             raise
+        finally:
+            deadline.cancel()
         if not reply.get("ok"):
             if tele is not None:
                 # the transport worked; the remote handler refused/crashed

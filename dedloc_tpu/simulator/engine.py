@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import heapq
 import selectors
 from typing import Any, Awaitable, Optional
 
@@ -56,6 +57,26 @@ SIM_EPOCH = 1_000_000.0
 _IDLE_POLLS_BEFORE_DEADLOCK = 400
 _IDLE_POLL_REAL_S = 0.005
 
+# real-readiness polling cadence: in a pure simulation the only registered
+# fd is the loop's self-pipe, whose sole job is waking a BLOCKED select —
+# and this selector never blocks. ``call_soon_threadsafe`` appends its
+# handle to ``_ready`` regardless, so skipping the poll can never lose a
+# callback; the pipe is drained every Nth tick (and on every idle tick) so
+# its buffer stays bounded. One real ``select(0)`` per event was ~15% of a
+# large scenario's wall time.
+_REAL_POLL_EVERY = 64
+
+# same-instant timer batching: every ``call_at`` deadline carries a seeded
+# tie-break epsilon in (0, ~2.002e-6] (FakeClock.tiebreak_epsilon at the
+# default 1e-6 scale), so timers for one MODELED instant are spread over a
+# ~2 µs band and, at asyncio's default nanosecond clock resolution, each
+# cost a full loop iteration. Widening the loop's ``_clock_resolution`` to
+# cover the whole band pops the batch in ONE iteration — still in heap
+# (= seeded tie-break) order. Safe because distinct modeled instants are
+# always >= _STREAM_STEP_S (1e-5 s) apart at the network layer and >= ms
+# in scenario code, both far above this window.
+_BATCH_RESOLUTION_S = 2.5e-6
+
 
 class _JumpingSelector:
     """Selector proxy: polls real readiness (the loop's self-pipe, mostly)
@@ -65,12 +86,23 @@ class _JumpingSelector:
         self._inner = inner
         self._loop = loop
         self._idle_polls = 0
+        self._ticks_since_real_poll = 0
 
     def select(self, timeout: Optional[float] = None):
-        events = self._inner.select(0)
-        if events:
-            self._idle_polls = 0
-            return events
+        # throttled real poll (see _REAL_POLL_EVERY): a simulation tick
+        # normally skips the syscall entirely. Any extra registered fd
+        # (beyond the loop's own self-pipe) disables the throttle — real
+        # I/O readiness must not be deferred by up to N virtual events.
+        self._ticks_since_real_poll += 1
+        if (
+            self._ticks_since_real_poll >= _REAL_POLL_EVERY
+            or len(self._inner.get_map()) > 1
+        ):
+            self._ticks_since_real_poll = 0
+            events = self._inner.select(0)
+            if events:
+                self._idle_polls = 0
+                return events
         if timeout is not None and timeout > 0:
             # nothing ready, next loop timer is ``timeout`` virtual seconds
             # out: this is the discrete-event jump. Land EXACTLY on the
@@ -105,12 +137,39 @@ class _JumpingSelector:
                 return []
             self._idle_polls += 1
             if self._idle_polls >= _IDLE_POLLS_BEFORE_DEADLOCK:
-                raise RuntimeError(
-                    "simulation deadlocked: no ready callbacks, no timers, "
-                    "and nothing external to wait for"
-                )
+                raise RuntimeError(self._deadlock_message())
+            self._ticks_since_real_poll = 0
             return self._inner.select(_IDLE_POLL_REAL_S)
         return []
+
+    def _deadlock_message(self) -> str:
+        """A deadlock report a wedged 10k-peer CI run is debuggable from:
+        how many sleepers are pending-but-unreachable (nothing left that
+        could ever advance the clock to them), and which stalled task is
+        the oldest (lowest creation sequence — usually the one everybody
+        else transitively awaits)."""
+        loop = self._loop
+        stats = loop.clock.sleeper_stats()
+        tasks = [t for t in asyncio.all_tasks(loop) if not t.done()]
+
+        def _task_age(task: "asyncio.Task") -> tuple:
+            name = task.get_name()
+            digits = name.rsplit("-", 1)[-1]
+            return (0, int(digits)) if digits.isdigit() else (1, 0)
+
+        oldest = min(tasks, key=_task_age) if tasks else None
+        oldest_desc = "none"
+        if oldest is not None:
+            coro = oldest.get_coro()
+            coro_name = getattr(coro, "__qualname__", repr(coro))
+            oldest_desc = f"{oldest.get_name()!r} ({coro_name})"
+        return (
+            "simulation deadlocked: no ready callbacks, no timers, and "
+            "nothing external to wait for "
+            f"(unreachable sleepers: {stats['live']} live + "
+            f"{stats['cancelled_resident']} cancelled-resident; "
+            f"stalled tasks: {len(tasks)}, oldest: {oldest_desc})"
+        )
 
     def __getattr__(self, name: str) -> Any:
         return getattr(self._inner, name)
@@ -123,6 +182,10 @@ class SimLoop(asyncio.SelectorEventLoop):
         super().__init__()
         self.clock = clock
         self._selector = _JumpingSelector(self._selector, self)
+        # batch the per-instant epsilon spread into one loop iteration
+        # (see _BATCH_RESOLUTION_S): heap order within the batch is the
+        # seeded tie-break order, so determinism is unchanged
+        self._clock_resolution = _BATCH_RESOLUTION_S
 
     def time(self) -> float:
         return self.clock.offset
@@ -131,11 +194,18 @@ class SimLoop(asyncio.SelectorEventLoop):
         # the seeded tie-break (see FakeClock.tiebreak_epsilon): distinct
         # deadlines make same-timestamp ordering a function of the seed,
         # and the microsecond-scale magnitude can never move a deadline
-        # across any boundary a scenario models (latencies are >= ms)
-        return super().call_at(
-            when + self.clock.tiebreak_epsilon(), callback, *args,
-            context=context,
+        # across any boundary a scenario models (latencies are >= ms).
+        # Inlined TimerHandle construction (the non-debug body of
+        # BaseEventLoop.call_at): this is the hottest call site of a large
+        # scenario — several hundred thousand timers — and the base-class
+        # wrapper's debug/closed checks measurably add up.
+        timer = asyncio.TimerHandle(
+            when + self.clock.tiebreak_epsilon(), callback, args, self,
+            context,
         )
+        heapq.heappush(self._scheduled, timer)
+        timer._scheduled = True
+        return timer
 
     def run_in_executor(self, executor, func, *args):
         # inline for determinism: thread completion order is real-time

@@ -331,10 +331,18 @@ class SimNetwork:
     # ------------------------------------------------------------- delivery
 
     def _transmit(self, conn: _SimConnection, side: int, payload: bytes) -> None:
-        loop = asyncio.get_event_loop()
+        # hot path: called once per flushed frame — a large scenario pushes
+        # hundreds of thousands through here. get_running_loop (we are
+        # always under a coroutine's drain) beats get_event_loop, and the
+        # link-override dict is only consulted when overrides exist.
+        loop = asyncio.get_running_loop()
         now = loop.time()
-        src, dst = conn.host(side), conn.host(1 - side)
-        spec = self.link(src, dst)
+        src = conn.addrs[side][0]
+        dst = conn.addrs[1 - side][0]
+        spec = (
+            self._links.get((src, dst), self.default_link)
+            if self._links else self.default_link
+        )
         # composable fault point: scenario schedules can drop, delay, error
         # or kill one directed link's deliveries without touching peer
         # code. Same action contract as apply_transport_fault: ``drop`` /
@@ -370,18 +378,28 @@ class SimNetwork:
             return
         # serialized uplink: one transmission at a time per source host —
         # except sub-MTU control frames, which interleave (see
-        # _SMALL_FRAME_BYTES above) and do not extend the busy window
-        small = len(payload) <= _SMALL_FRAME_BYTES
-        start = (
-            now if small
-            else max(now, self._uplink_busy_until.get(src, 0.0))
-        )
-        if spec.bandwidth_bps > 0.0:
-            done = start + len(payload) / spec.bandwidth_bps
+        # _SMALL_FRAME_BYTES above) and do not extend the busy window.
+        # Uncontended fast path: the busy map is allocated lazily per host
+        # (first rate-limited frame), and while NO host has ever contended
+        # — the common pure-latency scenario — the math is branch-only.
+        nbytes = len(payload)
+        small = nbytes <= _SMALL_FRAME_BYTES
+        if small:
+            start = now
         else:
+            prior = (
+                self._uplink_busy_until.get(src, 0.0)
+                if self._uplink_busy_until else 0.0
+            )
+            start = prior if prior > now else now
+        if spec.bandwidth_bps > 0.0:
+            done = start + nbytes / spec.bandwidth_bps
+            if not small:
+                self._uplink_busy_until[src] = done
+        else:
+            # infinite rate: the busy window is a point at ``start`` — an
+            # entry would never delay anyone, so none is written
             done = start
-        if not small:
-            self._uplink_busy_until[src] = done
         arrival = done + spec.latency_s + delay_extra
         if spec.jitter_s > 0.0:
             arrival += self.rng.uniform(0.0, spec.jitter_s)
@@ -393,10 +411,9 @@ class SimNetwork:
         arrival = max(arrival, conn.arrival_cursor[side] + _STREAM_STEP_S)
         conn.arrival_cursor[side] = arrival
         key = (src, dst)
-        self.stats["bytes"][key] = (
-            self.stats["bytes"].get(key, 0) + len(payload)
-        )
-        self.stats["flushes"][key] = self.stats["flushes"].get(key, 0) + 1
+        stats = self.stats
+        stats["bytes"][key] = stats["bytes"].get(key, 0) + nbytes
+        stats["flushes"][key] = stats["flushes"].get(key, 0) + 1
         loop.call_at(arrival, self._deliver, conn, 1 - side, payload)
 
     def _deliver(self, conn: _SimConnection, to_side: int, payload: bytes) -> None:

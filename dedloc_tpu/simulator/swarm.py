@@ -21,6 +21,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+from bisect import bisect_left
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -34,7 +35,7 @@ from dedloc_tpu.checkpointing.manifest import CheckpointManifest, shard_bytes
 from dedloc_tpu.core.serialization import CompressionType, serialize_array
 from dedloc_tpu.core.timeutils import get_dht_time
 from dedloc_tpu.dht.node import DHTNode
-from dedloc_tpu.dht.routing import DHTID
+from dedloc_tpu.dht.routing import DHTID, ID_BITS, NodeInfo
 from dedloc_tpu.simulator.network import SimNetwork
 from dedloc_tpu.telemetry.registry import Telemetry
 from dedloc_tpu.utils.logging import get_logger
@@ -80,27 +81,39 @@ class SimPeer:
         self.index = index
         self.label = label
         self.host = host
-        # link_top_k raised to the LinkTable's own bound: the 8-link cap
-        # protects the signed metrics-bus SNAPSHOT, but a simulated peer
-        # dumps to JSONL post-run, and a twin fitted from that dump needs
-        # every link's RTT, not just the 8 busiest.
-        # clock: the VIRTUAL clock, not the fake-clock-aware monotonic —
-        # span durations then measure only MODELED time, not the real
-        # Python seconds the host happened to spend executing the
-        # scenario. That noise was ±5-15% of a sub-second round wall and
-        # varied run to run, which both blurred the determinism story and
-        # put a floor under digital-twin fidelity. (Outside the engine
-        # get_dht_time is the wall clock — the interactive-debug case
-        # keeps real timings.)
-        self.telemetry = Telemetry(
-            peer=label, max_events=self.MAX_EVENTS, link_top_k=64,
-            clock=get_dht_time,
-        )
+        # telemetry is LAZY: a shell peer (spawn_shells) that never comes
+        # online must cost ~nothing, and a 10k-peer diurnal swarm keeps
+        # most of its roster offline at any instant. The registry is
+        # created on first touch — which for a full peer is node
+        # hydration, i.e. the moment it can first emit an event.
+        self._telemetry: Optional[Telemetry] = None
         self.node: Optional[DHTNode] = None
         self.matchmaking: Optional[Matchmaking] = None
         self.alive = False
         # catalog-provider state (when announcing): (manifest, flat)
         self._checkpoint = None
+
+    @property
+    def telemetry(self) -> Telemetry:
+        t = self._telemetry
+        if t is None:
+            # link_top_k raised to the LinkTable's own bound: the 8-link
+            # cap protects the signed metrics-bus SNAPSHOT, but a simulated
+            # peer dumps to JSONL post-run, and a twin fitted from that
+            # dump needs every link's RTT, not just the 8 busiest.
+            # clock: the VIRTUAL clock, not the fake-clock-aware monotonic
+            # — span durations then measure only MODELED time, not the
+            # real Python seconds the host happened to spend executing
+            # the scenario. That noise was ±5-15% of a sub-second round
+            # wall and varied run to run, which both blurred the
+            # determinism story and put a floor under digital-twin
+            # fidelity. (Outside the engine get_dht_time is the wall
+            # clock — the interactive-debug case keeps real timings.)
+            t = self._telemetry = Telemetry(
+                peer=self.label, max_events=self.MAX_EVENTS, link_top_k=64,
+                clock=get_dht_time,
+            )
+        return t
 
     @property
     def endpoint(self):
@@ -197,6 +210,7 @@ class SimSwarm:
         parallel_rpc: int = 3,
         request_timeout: float = 5.0,
         record_validators=(),
+        warm_spawn: bool = False,
     ):
         self.network = network
         self.seed = int(seed)
@@ -205,7 +219,21 @@ class SimSwarm:
         self.parallel_rpc = parallel_rpc
         self.request_timeout = request_timeout
         self.record_validators = record_validators
+        # warm_spawn: hydrate new peers by INJECTING routing-table contacts
+        # from the swarm's known deterministic topology instead of paying
+        # per-peer bootstrap RPCs (ping fanout + self-lookup). The injected
+        # table approximates a CONVERGED Kademlia network — k sorted-order
+        # neighbors (the deep buckets) plus one contact per XOR-distance
+        # level (the shallow ones) — which is what bootstrap-plus-
+        # maintenance converges to anyway. Scenario campaigns opt in; unit
+        # tests keep the eager RPC path so the bootstrap protocol itself
+        # stays covered.
+        self.warm_spawn = bool(warm_spawn)
         self.peers: List[SimPeer] = []
+        # peers that are alive AND listening, in spawn order — maintained
+        # incrementally so bootstrap-seed selection is O(fanout), not a
+        # rescan of the whole roster per joining peer (O(n^2) at 1k peers)
+        self._live_listening: List[SimPeer] = []
 
     # -------------------------------------------------------------- spawn
 
@@ -219,40 +247,173 @@ class SimSwarm:
         bootstrap_fanout: int = 2,
         client_mode: bool = False,
         maintenance_interval: float = 0.0,
+        warm: Optional[bool] = None,
     ) -> List[SimPeer]:
-        """Create ``n`` peers, each bootstrapping off up to
-        ``bootstrap_fanout`` already-live peers (deterministically chosen).
+        """Create ``n`` peers. On the eager path each bootstraps off up to
+        ``bootstrap_fanout`` already-live peers (deterministically chosen)
+        with real ping + self-lookup RPCs; on the warm path (``warm=True``
+        or the swarm's ``warm_spawn`` default) routing tables are injected
+        directly from the known topology and no bootstrap traffic happens.
         Background maintenance defaults OFF — scenarios drive
         ``run_maintenance`` explicitly so every run replays identically."""
+        warm = self.warm_spawn if warm is None else bool(warm)
         created: List[SimPeer] = []
         for i in range(n):
             index = len(self.peers)
             label = f"peer-{index:04d}"
             peer = SimPeer(index, label, host=label)
-            seeds = self._bootstrap_endpoints(index, bootstrap_fanout)
-            peer.node = await DHTNode.create(
-                listen_host=peer.host,
-                initial_peers=seeds,
-                node_id=self._node_id(index),
-                bucket_size=self.bucket_size,
-                num_replicas=self.num_replicas,
-                parallel_rpc=self.parallel_rpc,
-                request_timeout=self.request_timeout,
-                record_validators=[v() if callable(v) else v
-                                   for v in self.record_validators],
-                client_mode=client_mode,
-                advertised_host=peer.host,
-                maintenance_interval=maintenance_interval,
-                transport=self.network.transport(peer.host),
-                telemetry_registry=peer.telemetry,
+            seeds = () if warm else self._bootstrap_endpoints(
+                index, bootstrap_fanout
             )
-            peer.alive = True
+            await self._create_node(
+                peer, seeds, client_mode, maintenance_interval
+            )
+            self.peers.append(peer)
+            created.append(peer)
+        if warm:
+            # fill AFTER the whole batch is listening so the batch is
+            # mutually visible (like a settled network), not a join chain
+            self._warm_fill(created)
+        return created
+
+    async def _create_node(
+        self, peer: SimPeer, seeds, client_mode: bool,
+        maintenance_interval: float,
+    ) -> None:
+        peer.node = await DHTNode.create(
+            listen_host=peer.host,
+            initial_peers=seeds,
+            node_id=self._node_id(peer.index),
+            bucket_size=self.bucket_size,
+            num_replicas=self.num_replicas,
+            parallel_rpc=self.parallel_rpc,
+            request_timeout=self.request_timeout,
+            record_validators=[v() if callable(v) else v
+                               for v in self.record_validators],
+            client_mode=client_mode,
+            advertised_host=peer.host,
+            maintenance_interval=maintenance_interval,
+            transport=self.network.transport(peer.host),
+            telemetry_registry=peer.telemetry,
+        )
+        peer.alive = True
+        if peer.endpoint is not None:
+            self._live_listening.append(peer)
+
+    # ------------------------------------------------------ lazy hydration
+
+    def spawn_shells(self, n: int) -> List[SimPeer]:
+        """Reserve ``n`` roster slots as cheap OFFLINE shells: deterministic
+        index/label/host, no DHT node, no telemetry, no sockets. A shell
+        costs a few object headers; ``hydrate`` brings one online when it
+        is first touched. This is how the 10k-peer diurnal scenario affords
+        a planet-size roster of which only a time-of-day wave is live."""
+        created: List[SimPeer] = []
+        for _ in range(n):
+            index = len(self.peers)
+            label = f"peer-{index:04d}"
+            peer = SimPeer(index, label, host=label)
             self.peers.append(peer)
             created.append(peer)
         return created
 
+    async def hydrate(
+        self,
+        peer: SimPeer,
+        maintenance_interval: float = 0.0,
+        warm: Optional[bool] = None,
+    ) -> SimPeer:
+        """Bring a shell — or a previously killed peer rejoining under the
+        same identity — online. Idempotent for live peers. The node id is
+        the peer's deterministic identity, so a rejoin reclaims its old
+        place in the keyspace (its stored records died with it; its id did
+        not)."""
+        await self.hydrate_batch([peer], maintenance_interval, warm)
+        return peer
+
+    async def hydrate_batch(
+        self,
+        peers: Sequence[SimPeer],
+        maintenance_interval: float = 0.0,
+        warm: Optional[bool] = None,
+    ) -> List[SimPeer]:
+        """Hydrate a whole wave at once: the warm fill then sorts the live
+        roster ONCE for the batch instead of once per peer — the diurnal
+        scenario brings thousands online per virtual hour through this."""
+        warm = self.warm_spawn if warm is None else bool(warm)
+        fresh: List[SimPeer] = []
+        for peer in peers:
+            if peer.alive and peer.node is not None:
+                continue
+            seeds = () if warm else self._bootstrap_endpoints(peer.index, 2)
+            await self._create_node(
+                peer, seeds, client_mode=False,
+                maintenance_interval=maintenance_interval,
+            )
+            fresh.append(peer)
+        if warm and fresh:
+            self._warm_fill(fresh)
+        return fresh
+
+    def _warm_fill(self, created: Sequence[SimPeer]) -> None:
+        """Inject each created peer's routing table directly instead of
+        bootstrapping over RPC. Contacts chosen to match what a CONVERGED
+        table looks like: one peer per populated XOR-distance level (the
+        shallow buckets — each halves the remaining lookup distance) plus
+        ``bucket_size`` sorted-order neighbors on each side (adjacent ids
+        share the longest prefixes, i.e. they are the deep buckets).
+        Everything is derived from (seed, peer index), so two same-seed
+        runs inject identical tables. Existing peers do NOT learn the
+        newcomers here — exactly like a real join, they discover them when
+        the newcomers first send traffic (``_register_sender``)."""
+        roster = sorted(
+            (int(p.node.node_id), p) for p in self._live_listening
+        )
+        ids = [node_id for node_id, _ in roster]
+        if len(ids) <= 1:
+            return
+        k = self.bucket_size
+        for peer in created:
+            table = peer.node.routing_table
+            own = int(peer.node.node_id)
+            pos = bisect_left(ids, own)
+            h = int.from_bytes(
+                hashlib.sha256(
+                    f"{self.seed}:warm:{peer.index}".encode()
+                ).digest()[:8],
+                "big",
+            )
+            # far-to-near: one contact from each non-empty sibling subtree
+            # along our id's prefix path. The sibling subtree at depth L is
+            # a CONTIGUOUS range of the sorted id list, so each level is
+            # two bisects; levels go empty for good once subtrees shrink
+            # below the roster's resolution, so bail after a run of them.
+            empty_streak = 0
+            for level in range(ID_BITS):
+                shift = ID_BITS - 1 - level
+                lo = (own ^ (1 << shift)) >> shift << shift
+                i0 = bisect_left(ids, lo)
+                i1 = bisect_left(ids, lo + (1 << shift))
+                if i1 <= i0:
+                    empty_streak += 1
+                    if empty_streak >= 8:
+                        break
+                    continue
+                empty_streak = 0
+                _nid, contact = roster[i0 + (h + level * 7919) % (i1 - i0)]
+                if contact is not peer:
+                    table.add_or_update_node(
+                        NodeInfo(contact.node.node_id, contact.endpoint)
+                    )
+            for j in range(max(0, pos - k), min(len(ids), pos + k + 1)):
+                _nid, contact = roster[j]
+                if contact is not peer:
+                    table.add_or_update_node(
+                        NodeInfo(contact.node.node_id, contact.endpoint)
+                    )
+
     def _bootstrap_endpoints(self, index: int, fanout: int) -> List:
-        alive = [p for p in self.peers if p.alive and p.endpoint is not None]
+        alive = self._live_listening
         if not alive or fanout <= 0:
             return []
         # deterministic spread WITHOUT consuming shared RNG state: stride
@@ -274,6 +435,10 @@ class SimSwarm:
         if not peer.alive:
             return
         peer.alive = False
+        try:
+            self._live_listening.remove(peer)
+        except ValueError:
+            pass  # client-mode peer — was never listening
         self.network.kill_host(peer.host)
         if peer.node is not None:
             await peer.node.shutdown()
@@ -286,6 +451,7 @@ class SimSwarm:
             peer.alive = False
             self.network.kill_host(peer.host)
             await peer.node.shutdown()
+        self._live_listening.clear()
 
     # ---------------------------------------------------------- telemetry
 
@@ -298,6 +464,8 @@ class SimSwarm:
         os.makedirs(out_dir, exist_ok=True)
         paths = []
         for peer in self.peers:
+            if peer._telemetry is None:
+                continue  # never hydrated — nothing was ever recorded
             links = peer.telemetry._links
             if links is not None:
                 # the link.stats flush production peers do on snapshot /
@@ -330,6 +498,8 @@ class SimSwarm:
         determinism fingerprint two same-seed runs must agree on."""
         out: List[Dict[str, Any]] = []
         for peer in self.peers:
+            if peer._telemetry is None:
+                continue  # unhydrated shell — no events by construction
             for record in peer.telemetry.events:
                 out.append(
                     {k: v for k, v in record.items() if k not in drop_keys}
@@ -338,7 +508,7 @@ class SimSwarm:
 
     def counters_total(self, name: str) -> float:
         return sum(
-            p.telemetry.counters[name].value
+            p._telemetry.counters[name].value
             for p in self.peers
-            if name in p.telemetry.counters
+            if p._telemetry is not None and name in p._telemetry.counters
         )

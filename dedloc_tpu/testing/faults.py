@@ -200,15 +200,25 @@ async def apply_transport_fault(fault: Fault, what: str) -> None:
 
 
 class ClockHandle:
-    """Cancellation handle for a ``FakeClock.wake_at`` sleeper."""
+    """Cancellation handle for a ``FakeClock.wake_at`` sleeper.
 
-    __slots__ = ("cancelled",)
+    ``cancel`` notifies the owning clock so cancelled sleepers are counted
+    (and compacted) EAGERLY instead of lingering until they surface at the
+    head of the schedule — a churn wave that cancels thousands of pending
+    wakes must not leave the clock walking dead entries for the rest of
+    the run."""
 
-    def __init__(self) -> None:
+    __slots__ = ("cancelled", "_clock")
+
+    def __init__(self, clock: Optional["FakeClock"] = None) -> None:
         self.cancelled = False
+        self._clock = clock
 
     def cancel(self) -> None:
-        self.cancelled = True
+        if not self.cancelled:
+            self.cancelled = True
+            if self._clock is not None:
+                self._clock._note_cancelled()
 
 
 class FakeClock:
@@ -248,15 +258,43 @@ class FakeClock:
     behavior is unchanged for existing tests.
     """
 
+    # timer-wheel geometry. Sleeper rows are binned by ABSOLUTE slot index
+    # (``int(when / slot_width)``) into sparse dict-of-bucket levels, so
+    # there is no modulo wrap to disambiguate: bucket index order IS time
+    # order. Level 0 holds the dominant near-term band (sub-second waits),
+    # level 1 the next ~65 s, and everything farther rides an overflow
+    # heap. Scheduling is O(1); firing sorts one bucket at a time (each
+    # row is sorted exactly once, amortized O(log bucket)).
+    _L0_SLOT_S = 1e-3
+    _L0_SPAN_S = 0.256
+    _L1_SLOT_S = 0.256
+    _L1_SPAN_S = 65.536
+
     def __init__(self, start: float = 0.0, seed: int = 0,
                  frozen: bool = False):
         self.offset = float(start)
         self.frozen = bool(frozen)
         self.rng = random.Random(seed)
-        # heap rows: (when, tiebreak, seq, callback, handle) — ``tiebreak``
-        # is the seeded draw that defines same-deadline order; ``seq`` only
-        # breaks the astronomically-unlikely equal-draw case
-        self._sleepers: List[tuple] = []
+        # rows: (when, tiebreak, seq, callback, handle) — ``tiebreak`` is
+        # the seeded draw that defines same-deadline order; ``seq`` only
+        # breaks the astronomically-unlikely equal-draw case. Rows live in
+        # the wheel buckets / overflow heap; during an ``advance_to`` drain
+        # the due ones move to the ``_due`` heap, which replays them in
+        # exact (when, tiebreak, seq) order.
+        self._l0: Dict[int, List[tuple]] = {}
+        self._l1: Dict[int, List[tuple]] = {}
+        self._l0_idx: List[int] = []  # heaps of occupied bucket indices
+        self._l1_idx: List[int] = []
+        self._overflow: List[tuple] = []
+        self._due: List[tuple] = []
+        self._drain_target: Optional[float] = None
+        self._live = 0  # pending, not cancelled
+        self._cancelled_resident = 0  # cancelled but still occupying a row
+        # merged next-deadline cursor: the engine polls ``next_wake`` every
+        # virtual tick, so the earliest pending deadline is cached and
+        # updated incrementally on insert — an idle swarm pays O(1) per
+        # tick, not a wheel scan. ``None`` = "no sleepers", ``()`` = stale.
+        self._next_wake_cache: Any = None
         self._seq = 0
 
     # ------------------------------------------------------------- sleepers
@@ -281,26 +319,194 @@ class FakeClock:
     def wake_at(self, when: float, callback: Callable[[], Any]) -> ClockHandle:
         """Register ``callback`` to fire when scenario time reaches
         ``when`` (fired inside ``advance``, never from real time)."""
-        handle = ClockHandle()
-        heapq.heappush(
-            self._sleepers,
-            (float(when), self.rng.random(), self._seq, callback, handle),
-        )
+        handle = ClockHandle(self)
+        # the seeded draw MUST stay one-per-registration, taken here, in
+        # registration order — it is the documented same-deadline tie-break
+        # stream, and tests cross-check it against an independent
+        # ``random.Random(seed)``
+        row = (float(when), self.rng.random(), self._seq, callback, handle)
         self._seq += 1
+        self._live += 1
+        self._place(row)
+        cache = self._next_wake_cache
+        if cache is None or (cache != () and row[0] < cache):
+            self._next_wake_cache = row[0]
         return handle
 
+    def _place(self, row: tuple) -> None:
+        """Bin one row into the wheel level (or overflow heap) its distance
+        from now selects; rows due within an in-progress drain go straight
+        to the drain's replay heap."""
+        when = row[0]
+        if self._drain_target is not None and when <= self._drain_target:
+            heapq.heappush(self._due, row)
+            return
+        delta = when - self.offset
+        if delta < self._L0_SPAN_S:
+            buckets, idx_heap = self._l0, self._l0_idx
+            idx = int(when // self._L0_SLOT_S)
+        elif delta < self._L1_SPAN_S:
+            buckets, idx_heap = self._l1, self._l1_idx
+            idx = int(when // self._L1_SLOT_S)
+        else:
+            heapq.heappush(self._overflow, row)
+            return
+        bucket = buckets.get(idx)
+        if bucket is None:
+            buckets[idx] = [row]
+            heapq.heappush(idx_heap, idx)
+        else:
+            bucket.append(row)
+
+    def _note_cancelled(self) -> None:
+        """Eager cancellation accounting (called by ``ClockHandle.cancel``):
+        move one row from live to cancelled-resident and compact the wheel
+        once dead rows outnumber live ones — a mass-cancel churn wave must
+        not leave the schedule mostly tombstones."""
+        self._live -= 1
+        self._cancelled_resident += 1
+        self._next_wake_cache = ()  # the cancelled row may have been the min
+        if self._cancelled_resident > 64 and \
+                self._cancelled_resident > self._live:
+            self._compact()
+
+    def _compact(self) -> None:
+        rows = [
+            row
+            for bucket_map in (self._l0, self._l1)
+            for bucket in bucket_map.values()
+            for row in bucket
+            if not row[4].cancelled
+        ]
+        rows += [row for row in self._overflow if not row[4].cancelled]
+        due = [row for row in self._due if not row[4].cancelled]
+        self._l0.clear()
+        self._l1.clear()
+        self._l0_idx.clear()
+        self._l1_idx.clear()
+        self._overflow.clear()
+        self._due.clear()
+        if due:
+            self._due = due
+            heapq.heapify(self._due)
+        self._cancelled_resident = 0
+        for row in rows:
+            self._place(row)
+
+    def _level_min(self, buckets: Dict[int, List[tuple]],
+                   idx_heap: List[int]) -> Optional[float]:
+        """Earliest live deadline in one wheel level: the lowest-indexed
+        bucket's min (bucket index order is time order). Cancelled rows
+        encountered on the way are dropped for good."""
+        while idx_heap:
+            idx = idx_heap[0]
+            bucket = buckets.get(idx)
+            if not bucket:
+                heapq.heappop(idx_heap)
+                buckets.pop(idx, None)
+                continue
+            best = None
+            live = []
+            for row in bucket:
+                if row[4].cancelled:
+                    self._cancelled_resident -= 1
+                    continue
+                live.append(row)
+                if best is None or row[0] < best:
+                    best = row[0]
+            if not live:
+                heapq.heappop(idx_heap)
+                buckets.pop(idx, None)
+                continue
+            if len(live) != len(bucket):
+                buckets[idx] = live
+            return best
+        return None
+
     def next_wake(self) -> Optional[float]:
-        """Earliest pending sleeper deadline, or None."""
-        while self._sleepers and self._sleepers[0][4].cancelled:
-            heapq.heappop(self._sleepers)
-        return self._sleepers[0][0] if self._sleepers else None
+        """Earliest pending sleeper deadline, or None (cached between
+        schedule mutations — the engine's merged-cursor read)."""
+        cache = self._next_wake_cache
+        if cache != ():
+            return cache
+        best = self._level_min(self._l0, self._l0_idx)
+        l1_best = self._level_min(self._l1, self._l1_idx)
+        if l1_best is not None and (best is None or l1_best < best):
+            best = l1_best
+        overflow = self._overflow
+        while overflow and overflow[0][4].cancelled:
+            heapq.heappop(overflow)
+            self._cancelled_resident -= 1
+        if overflow and (best is None or overflow[0][0] < best):
+            best = overflow[0][0]
+        self._next_wake_cache = best
+        return best
+
+    def _pull_due(self, target: float) -> None:
+        """Move every row with ``when <= target`` from the wheel levels and
+        the overflow heap onto the ``_due`` replay heap."""
+        due = self._due
+        for buckets, idx_heap, slot_s in (
+            (self._l0, self._l0_idx, self._L0_SLOT_S),
+            (self._l1, self._l1_idx, self._L1_SLOT_S),
+        ):
+            # compare in INDEX space with the same floor division used by
+            # ``_place``: float division is monotone, so ``when <= target``
+            # always implies ``row_idx <= target_idx`` and a row can never
+            # be stranded in a bucket the pull considers "later"
+            target_idx = int(target // slot_s)
+            while idx_heap:
+                idx = idx_heap[0]
+                bucket = buckets.get(idx)
+                if not bucket:
+                    heapq.heappop(idx_heap)
+                    buckets.pop(idx, None)
+                    continue
+                if idx > target_idx:
+                    break  # every later bucket is strictly later still
+                if idx < target_idx:  # bucket entirely due
+                    heapq.heappop(idx_heap)
+                    buckets.pop(idx, None)
+                    for row in bucket:
+                        heapq.heappush(due, row)
+                    continue
+                keep = [row for row in bucket if row[0] > target]
+                for row in bucket:
+                    if row[0] <= target:
+                        heapq.heappush(due, row)
+                if keep:
+                    buckets[idx] = keep
+                else:
+                    heapq.heappop(idx_heap)
+                    buckets.pop(idx, None)
+                break  # rows past this partially-due bucket are all later
+        overflow = self._overflow
+        while overflow and overflow[0][0] <= target:
+            heapq.heappush(due, heapq.heappop(overflow))
 
     def _fire_due(self) -> None:
-        while self._sleepers and self._sleepers[0][0] <= self.offset:
-            when, _tb, _seq, callback, handle = heapq.heappop(self._sleepers)
-            if handle.cancelled:
-                continue
-            callback()
+        """Fire every sleeper already due at the current offset."""
+        self.advance_to(self.offset)
+
+    def sleeper_stats(self) -> Dict[str, int]:
+        """Schedule occupancy, for diagnostics and regression tests:
+        ``live`` pending sleepers, ``resident`` rows actually held (live +
+        cancelled tombstones awaiting compaction)."""
+        resident = (
+            sum(len(b) for b in self._l0.values())
+            + sum(len(b) for b in self._l1.values())
+            + len(self._overflow)
+            + len(self._due)
+        )
+        return {
+            "live": self._live,
+            "resident": resident,
+            "cancelled_resident": self._cancelled_resident,
+            # lifetime registrations (wake_at rows + tie-break draws): the
+            # bench's "timer events scheduled" numerator — deterministic for
+            # a given seed+scenario, so events/sec isolates wall-time cost
+            "scheduled_total": self._seq,
+        }
 
     # ------------------------------------------------------------ lifecycle
 
@@ -321,11 +527,29 @@ class FakeClock:
         deadline order (seeded tie-break within one deadline); each sleeper
         observes the clock AT its own deadline."""
         target = float(target)
-        while self._sleepers and self._sleepers[0][0] <= target:
-            self.offset = max(self.offset, self._sleepers[0][0])
-            set_dht_time_offset(self.offset)
-            self._fire_due()
-        self.offset = max(self.offset, target)
+        if self._live:
+            previous_target = self._drain_target
+            self._drain_target = target
+            self._pull_due(target)
+            due = self._due
+            consumed = bool(due)
+            while due:
+                row = heapq.heappop(due)
+                if row[4].cancelled:
+                    self._cancelled_resident -= 1
+                    continue
+                self._live -= 1
+                when = row[0]
+                if when > self.offset:
+                    self.offset = when
+                    set_dht_time_offset(when)
+                row[3]()  # may register new due sleepers: _place routes
+                # anything <= target straight onto this replay heap
+            self._drain_target = previous_target
+            if consumed:
+                self._next_wake_cache = ()
+        if target > self.offset:
+            self.offset = target
         set_dht_time_offset(self.offset)
 
     def __exit__(self, *exc) -> None:

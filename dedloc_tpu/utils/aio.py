@@ -21,9 +21,13 @@ logger = get_logger(__name__)
 # itself on completion, so the set stays bounded by actual concurrency.
 # Tasks whose loop was closed/abandoned mid-flight never run their done-
 # callback, so a periodic sweep (below) prunes them — without it, per-test
-# loop churn would grow the set monotonically for the process lifetime
+# loop churn would grow the set monotonically for the process lifetime.
+# The cadence is deliberately coarse: each sweep is O(live tasks), and a
+# large simulation legitimately keeps tens of thousands of parked acceptor
+# tasks alive — sweeping those every 512 spawns was pure overhead. Memory
+# growth between sweeps stays bounded by _SWEEP_EVERY dead-loop tasks.
 _background: Set["asyncio.Future"] = set()
-_SWEEP_EVERY = 512
+_SWEEP_EVERY = 8192
 _spawn_count = 0
 
 

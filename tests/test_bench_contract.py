@@ -63,6 +63,37 @@ def test_bench_codec_mode_contract():
     assert record["n_params"] > 17_000_000  # the real ALBERT-large tree
 
 
+def test_bench_sim_engine_mode_contract():
+    """Virtual-time engine bench smoke (DEDLOC_BENCH=sim_engine): the tiny
+    roster runs the mixed scenario end-to-end and prints one JSON line with
+    the gate-facing keys. The metric name carries the roster size, so this
+    100-peer smoke can never gate against a full 1,000-peer round
+    (tools/bench_gate.py filters baselines by metric name).
+    DEDLOC_BENCH_TIMING=0 skips the 10,000-peer diurnal half — minutes of
+    scenario the tier-1 budget cannot carry."""
+    env = dict(os.environ, DEDLOC_BENCH="sim_engine",
+               DEDLOC_BENCH_TINY="1", DEDLOC_BENCH_TIMING="0",
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py")],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    json_lines = [
+        l for l in out.stdout.strip().splitlines() if l.startswith("{")
+    ]
+    assert len(json_lines) == 1, out.stdout
+    record = json.loads(json_lines[0])
+    assert record["metric"] == "sim_mixed100_timer_events_per_wall_sec"
+    assert record["unit"] == "events/sec"
+    assert record["value"] > 0 and record["wall_s"] > 0
+    assert record["events_scheduled"] > 0
+    assert record["peak_rss_mb"] > 0
+    assert record["vs_baseline"] == 1.0  # smoke roster: no anchor
+    assert "diurnal_10k" not in record  # the timing half was skipped
+
+
 def _run_pipeline_bench(timing=True):
     env = dict(os.environ, DEDLOC_BENCH="allreduce_pipeline",
                DEDLOC_BENCH_TINY="1", JAX_PLATFORMS="cpu",

@@ -95,6 +95,61 @@ def test_fakeclock_sleeper_cancellation_and_clock_at_deadline():
     assert clock.offset == 10.0
 
 
+def test_fakeclock_mass_cancel_keeps_schedule_compact():
+    """Regression (ISSUE 19 satellite): a churn wave that cancels most of
+    the schedule must not leave the timer wheel full of tombstones —
+    cancellation accounting is eager, and compaction fires once dead rows
+    outnumber live ones, so resident size tracks the LIVE schedule."""
+    clock = FakeClock(seed=3)
+    fired = []
+    handles = [
+        clock.wake_at(10.0 + 0.003 * i, lambda i=i: fired.append(i))
+        for i in range(5000)
+    ]
+    # the wave: 98% of the swarm departs, cancelling its timers
+    for handle in handles[:4900]:
+        handle.cancel()
+    stats = clock.sleeper_stats()
+    assert stats["live"] == 100
+    # compaction bound: between compactions at most max(64, live) + 1
+    # cancelled rows may sit resident, never the 4,900 we cancelled
+    assert stats["cancelled_resident"] <= 101, stats
+    assert stats["resident"] <= stats["live"] + 101, stats
+    # the survivors still fire — compaction never drops a live row
+    with clock:
+        clock.advance(30.0)
+    assert sorted(fired) == list(range(4900, 5000))
+    assert clock.sleeper_stats()["live"] == 0
+
+
+def test_fakeclock_tiebreak_epsilon_matches_independent_rng():
+    """The tie-break epsilon stream is a documented pure function of the
+    seed: cross-check it against an independent ``random.Random(seed)``
+    model, interleaved with ``wake_at`` registrations (which share the same
+    RNG stream and sequence counter). Any drift here silently reorders
+    same-instant timers across the whole simulator."""
+    clock = FakeClock(seed=7)
+    observed = []
+    for i in range(10):
+        clock.wake_at(100.0 + i, lambda: None)
+        observed.append(clock.tiebreak_epsilon())
+
+    reference = random.Random(7)
+    seq = 0
+    scale = 1e-6
+    expected = []
+    for _ in range(10):
+        reference.random()  # wake_at's registration-order draw
+        seq += 1
+        seq += 1  # tiebreak_epsilon pre-increments before drawing
+        expected.append(
+            (1.0 - reference.random()) * scale
+            + (seq % 1000 + 1) * scale * 1e-3
+        )
+    assert observed == expected  # exact float equality — same stream
+    assert all(e > 0.0 for e in observed)  # strictly positive, always
+
+
 # ---------------------------------------------------------------- engine
 
 
@@ -225,6 +280,46 @@ def test_engine_detects_deadlock():
         with pytest.raises(RuntimeError, match="deadlock"):
             engine.run(wedge())
     engine.close()
+
+
+def test_engine_deadlock_report_counts_sleepers_and_names_oldest_task():
+    """The deadlock RuntimeError must be debuggable from its message alone
+    (a wedged 10k-peer CI run yields nothing else): it reports how many
+    sleepers are pending-but-unreachable plus the cancelled-resident count,
+    and names the OLDEST stalled task — usually the one everybody else
+    transitively awaits."""
+    import re
+
+    engine = SimEngine(seed=0)
+
+    async def wedge():
+        async def parked():
+            await asyncio.get_event_loop().create_future()
+
+        asyncio.ensure_future(parked())  # a younger stalled task
+        # a cancelled sleeper leaves a tombstone the report accounts for
+        handle = engine.clock.wake_at(
+            engine.clock.offset + 99.0, lambda: None
+        )
+        handle.cancel()
+        await asyncio.get_event_loop().create_future()
+
+    with engine:
+        with pytest.raises(RuntimeError) as excinfo:
+            engine.run(wedge())
+    engine.close()
+    msg = str(excinfo.value)
+    assert "simulation deadlocked" in msg
+    assert re.search(
+        r"unreachable sleepers: \d+ live \+ \d+ cancelled-resident", msg
+    ), msg
+    # the oldest stalled task is the scenario root (lowest Task number),
+    # named with its coroutine so the wedge is attributable
+    match = re.search(r"stalled tasks: (\d+), oldest: 'Task-\d+' \((\S+)\)",
+                      msg)
+    assert match, msg
+    assert int(match.group(1)) >= 2  # the root + the parked child
+    assert "wedge" in match.group(2), msg
 
 
 # ------------------------------------------------------- framing parity
@@ -869,6 +964,67 @@ def test_scenario_mixed_1000_peers_deterministic_and_fast(tmp_path):
     assert cat["selected_majority"] and cat["restore_ok"]
 
 
+def _run_diurnal_once(spec):
+    """One diurnal run to (telemetry fingerprint, report) — the same
+    double-run harness the mixed acceptance test uses."""
+    from dedloc_tpu.simulator import scenarios as S
+
+    run = S.ScenarioRun(spec)
+    with run.engine:
+        run.engine.run(S.SCENARIOS["diurnal"](run), timeout=36000.0)
+        fingerprint = run.swarm.event_sequence()
+        report = dict(run.report)
+        run.engine.run(run.swarm.shutdown())
+    run.engine.close()
+    return fingerprint, report
+
+
+def test_scenario_diurnal_1000_roster_same_seed_identical():
+    """Lazy-hydration determinism at tier-1 scale: a 1,000-peer roster
+    cycling through 8 duty-window hours — shells, batch warm hydration,
+    kills, presence heartbeats — run twice with the same seed produces
+    identical telemetry event sequences and an identical scenario report.
+    Warm-start routing injection and lazy telemetry creation must not
+    introduce any order dependence. (8 hours, not a full day: each tier-1
+    second is budgeted — tools/t1_budget.py — and the wave machinery fully
+    exercises itself in one workday; the slow-marked 10k test runs the
+    full 24.)"""
+    spec = {"scenario": "diurnal", "peers": 1000, "hours": 8, "seed": 5}
+    fp1, rep1 = _run_diurnal_once(spec)
+    fp2, rep2 = _run_diurnal_once(spec)
+    assert len(fp1) > 100, "scenario produced suspiciously few events"
+    assert fp1 == fp2, "same seed produced different event sequences"
+    assert rep1["diurnal"] == rep2["diurnal"]
+    d = rep1["diurnal"]
+    assert d["hydrations"] > 0 and d["departures"] > 0
+    assert d["peak_online"] > 0
+    assert d["get_success"] >= 0.7
+
+
+@pytest.mark.slow  # two full 10k-peer 24-hour runs (~1 min wall each)
+def test_scenario_diurnal_10000_roster_same_seed_identical():
+    """The planet-scale acceptance (ISSUE 19): 10,000 peers over 24 virtual
+    hours of timezone waves complete in single-digit MINUTES of wall, twice,
+    with bit-identical telemetry — the proof that wall cost tracks the
+    active wave, not the roster, and that scale does not erode the
+    determinism contract."""
+    spec = {"scenario": "diurnal", "peers": 10000, "seed": 0}
+    wall0 = time.perf_counter()
+    fp1, rep1 = _run_diurnal_once(spec)
+    wall1 = time.perf_counter() - wall0
+    wall0 = time.perf_counter()
+    fp2, rep2 = _run_diurnal_once(spec)
+    wall2 = time.perf_counter() - wall0
+    assert min(wall1, wall2) < 540.0, (wall1, wall2)  # single-digit minutes
+    assert len(fp1) > 10000
+    assert fp1 == fp2, "same seed produced different event sequences"
+    assert rep1["diurnal"] == rep2["diurnal"]
+    d = rep1["diurnal"]
+    assert d["roster"] == 10000 and d["shells_never_online"] == 0
+    assert d["peak_online"] > 2000  # a third of the planet is awake
+    assert d["get_success"] >= 0.7
+
+
 def test_scenario_dht_fanout_1000_nodes_under_churn_via_cli(tmp_path):
     """The CLI face end to end at 1,000 nodes: ``tools/swarm_sim.py`` runs
     the dht_churn scenario, the report's sizing numbers hold their bounds,
@@ -894,11 +1050,14 @@ def test_scenario_dht_fanout_1000_nodes_under_churn_via_cli(tmp_path):
     assert dht["fanout_max"] <= dht["replica_bound"]
     assert dht["get_success"] >= 0.9
     assert dht["churned"] == 200
-    # the event logs feed the existing observability tooling
+    # the event logs feed the existing observability tooling. Telemetry
+    # is lazy: warm-hydrated peers that no operation ever touched record
+    # nothing, so only the peers the workload actually exercised dump a
+    # log — far fewer than the bootstrap-storm era's all-1000.
     import glob
 
     paths = glob.glob(str(out / "*.jsonl"))
-    assert len(paths) > 100
+    assert 40 <= len(paths) < 1000
     tools_dir = os.path.join(repo, "tools")
     sys.path.insert(0, tools_dir)
     try:

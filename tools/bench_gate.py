@@ -40,6 +40,14 @@ gate against each other. MULTICHIP rounds whose tail carries no applied
 steps (an early driver that captured only the jax banner) are simply
 absent from the baseline set — the same missing-round rule as a sparse
 glob.
+
+The simulator-engine trajectory (SIMBENCH_r*.json, DEDLOC_BENCH=sim_engine)
+rides the same machinery: it uses the BENCH_r*.json driver layout, its
+headline ``sim_mixed<peers>_timer_events_per_wall_sec`` is higher-is-better
+like every other gated metric, and the roster size in the metric name keeps
+CI smokes (DEDLOC_BENCH_TINY=1, 100 peers) from gating against full runs.
+Gate sim records with ``--tolerance 0.15`` — single-core wall variance is
+far wider than a TPU's (SIMBENCH_r01.json note).
 """
 from __future__ import annotations
 
@@ -55,6 +63,11 @@ from typing import Dict, List, Optional, Tuple
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE_GLOB = os.path.join(REPO_ROOT, "BENCH_r*.json")
 MULTICHIP_BASELINE_GLOB = os.path.join(REPO_ROOT, "MULTICHIP_r*.json")
+# the simulator-engine trajectory (DEDLOC_BENCH=sim_engine): same driver
+# layout as BENCH_r*.json, gated on the events/sec headline. Single-core
+# wall variance is ~±15%, so gate sim metrics with --tolerance 0.15
+# (SIMBENCH_r01.json note) rather than the TPU default.
+SIMBENCH_BASELINE_GLOB = os.path.join(REPO_ROOT, "SIMBENCH_r*.json")
 
 # "[2026-08-01 21:43:54.504][INFO][dedloc_tpu.collaborative.optimizer]
 #  global step 189 applied (group=1, samples~48)"
@@ -227,7 +240,7 @@ def main(argv=None) -> int:
     parser.add_argument(
         "baselines", nargs="*",
         help=f"baseline bench JSONs (default: {DEFAULT_BASELINE_GLOB} "
-             f"+ {MULTICHIP_BASELINE_GLOB})",
+             f"+ {MULTICHIP_BASELINE_GLOB} + {SIMBENCH_BASELINE_GLOB})",
     )
     parser.add_argument(
         "--tolerance", type=float, default=0.03,
@@ -240,11 +253,12 @@ def main(argv=None) -> int:
         print(f"error: fresh bench file {args.fresh} is not a bench record",
               file=sys.stderr)
         return 2
-    # both trajectories ride the default baseline set: the fresh record's
-    # metric name filters out the incomparable one
+    # all three trajectories ride the default baseline set: the fresh
+    # record's metric name filters out the incomparable ones
     paths = args.baselines or sorted(
         glob.glob(DEFAULT_BASELINE_GLOB)
         + glob.glob(MULTICHIP_BASELINE_GLOB)
+        + glob.glob(SIMBENCH_BASELINE_GLOB)
     )
     baselines = [r for r in (load_bench(p) for p in paths) if r is not None]
     text, code = gate(fresh, baselines, tolerance=args.tolerance)
