@@ -831,6 +831,61 @@ def run_sim_engine() -> None:
     print(json.dumps(result))
 
 
+def run_serving() -> None:
+    """Serving-plane bench (DEDLOC_BENCH=serving): the ISSUE 20 acceptance
+    scenario — a 1,000-peer fleet, 16 experts x 3 replicas, 8 gateways,
+    a bursty 400-request trace with 6 expert hosts killed mid-trace — on
+    the virtual-time engine. The headline is requests resolved per WALL
+    second (higher-is-better, as tools/bench_gate.py requires): the
+    request count is fixed by the spec, so the metric isolates the
+    serving plane's Python cost (discovery parse, candidate ranking,
+    hedged dispatch, telemetry) from workload drift. p99 latency and the
+    fall-through rate ride along as SLO context — p99 is VIRTUAL time
+    (the simulated fleet's latency), wall is the box's cost to simulate
+    it.
+
+    DEDLOC_BENCH_TINY=1 shrinks the fleet for a CI smoke; the metric name
+    carries the roster size so a smoke never gates against the full run.
+    """
+    import resource
+
+    from dedloc_tpu.simulator import scenarios as S
+
+    tiny = os.environ.get("DEDLOC_BENCH_TINY", "") == "1"
+    peers = 40 if tiny else 1000
+    spec = {
+        "scenario": "serving", "peers": peers, "seed": 0,
+        "experts": 4 if tiny else 16,
+        "hosts_per_expert": 2 if tiny else 3,
+        "gateways": 2 if tiny else 8,
+        "requests": 40 if tiny else 400,
+        "burst": 4 if tiny else 8,
+        "tokens": 16, "hidden": 8,
+        "kill_hosts": 0 if tiny else 6, "kill_at_frac": 0.5,
+    }
+    wall0 = time.perf_counter()
+    report = S.run_scenario(spec)
+    wall = time.perf_counter() - wall0
+    serving = report["serving"]
+    print(json.dumps({
+        "metric": f"serving{peers}_requests_per_wall_sec",
+        "value": round(serving["completed"] / wall, 1),
+        "unit": "requests/sec",
+        "wall_s": round(wall, 3),
+        "virtual_s": report["virtual_s"],
+        "requests": serving["requests"],
+        "served": serving["served"],
+        "wedged": serving["wedged"],
+        "fall_through_rate": serving["fall_through_rate"],
+        "latency_p50_s": serving["latency_p50_s"],
+        "latency_p99_s": serving["latency_p99_s"],
+        "load_skew": serving["load_skew"],
+        "peak_rss_mb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1
+        ),
+    }))
+
+
 def main() -> None:
     if os.environ.get("DEDLOC_BENCH") == "codec":
         run_codec()
@@ -852,6 +907,9 @@ def main() -> None:
         return
     if os.environ.get("DEDLOC_BENCH") == "sim_engine":
         run_sim_engine()
+        return
+    if os.environ.get("DEDLOC_BENCH") == "serving":
+        run_serving()
         return
     from dedloc_tpu.models.albert import (
         AlbertConfig,

@@ -79,6 +79,7 @@ def make_validators(
     signature-bound to that peer (dedloc_tpu/checkpointing/catalog.py)."""
     from dedloc_tpu.averaging.planwire import PlanRecord
     from dedloc_tpu.checkpointing.catalog import CheckpointAnnouncement
+    from dedloc_tpu.serving.records import ExpertRecord
     from dedloc_tpu.telemetry.ledger import ContributionClaim, RoundReceipt
 
     signature = RSASignatureValidator(private_key)
@@ -95,6 +96,10 @@ def make_validators(
             # the coordinator's fold never sees a structurally bad record
             "contribution_ledger": ContributionClaim,
             "round_receipts": RoundReceipt,
+            # expert serving discovery (serving/records.py): a malformed
+            # or identity-mismatched expert announcement is rejected at
+            # the storing node, not discovered by a routing gateway
+            "experts": ExpertRecord,
         },
         prefix=prefix,
     )

@@ -425,6 +425,34 @@ class TelemetryArguments:
 
 
 @dataclass
+class ServingArguments:
+    """Swarm-sharded MoE serving (dedloc_tpu/serving, docs/serving.md):
+    expert shards hosted across peers, discovered via the signed
+    ``{prefix}_experts`` DHT namespace, routed latency/load-aware by the
+    gateway with deadline/retry/hedge and a residual fall-through."""
+
+    enabled: bool = False
+    # gateway routing policy (serving/router.py RouterPolicy)
+    refresh_period: float = 5.0  # expert-directory staleness bound, s
+    request_deadline: float = 2.0  # total per-request budget, s
+    attempt_timeout: float = 0.6  # per-attempt RPC timeout, s
+    retries: int = 2  # extra attempts after the first
+    backoff: float = 0.05  # base transport-failure backoff, doubled
+    hedge_after: float = 0.3  # fire the runner-up after this wait, s
+    # expert-host knobs (serving/host.py)
+    capacity: int = 4096  # max tokens admitted per dispatch window
+    announce_period: float = 10.0  # expert-record refresh cadence, s
+    # per-peer token-bucket admission on the dispatch RPC (0 rate = open)
+    admission_rate: float = 50.0
+    admission_burst: float = 100.0
+    # per-peer token-bucket admission on the DHT store RPC (0 = open; the
+    # public-run hardening knob — over-rate stores are refused with a
+    # named reason and counted under serve.rejected)
+    store_rate: float = 0.0
+    store_burst: float = 0.0
+
+
+@dataclass
 class AuthArguments:
     """Gated-run credentials (sahajbert/huggingface_auth.py capability):
     when ``username`` is set, the role fetches a signed access token from
@@ -448,6 +476,7 @@ class CollaborationArguments:
     auth: AuthArguments = field(default_factory=AuthArguments)
     telemetry: TelemetryArguments = field(default_factory=TelemetryArguments)
     checkpoint: CheckpointArguments = field(default_factory=CheckpointArguments)
+    serving: ServingArguments = field(default_factory=ServingArguments)
     wandb_project: Optional[str] = None
     bandwidth: float = 1000.0
 

@@ -17,6 +17,7 @@ from dedloc_tpu.dht.protocol import Endpoint, RPCClient, RPCServer
 from dedloc_tpu.dht.routing import DHTID, NodeInfo, RoutingTable
 from dedloc_tpu.dht.storage import DHTLocalStorage, DictionaryDHTValue
 from dedloc_tpu.dht.validation import CompositeValidator, DHTRecord, RecordValidatorBase
+from dedloc_tpu.telemetry import registry as telemetry
 from dedloc_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -66,9 +67,14 @@ class DHTNode:
         # simulator passes its in-process network so 1000 nodes share a loop
         telemetry_registry=None,  # per-peer scope for in-process multi-peer
         # runs (telemetry/registry.py); None falls back to the global
+        store_admission=None,  # serving/admission.Admission: per-sender
+        # token bucket on the store RPC (public-run rate control); None =
+        # open (the default — store volume is already bounded by validators)
     ) -> "DHTNode":
         self = object.__new__(cls)
         self.node_id = node_id or DHTID.generate()
+        self.store_admission = store_admission
+        self.telemetry = telemetry_registry
         self.bucket_size = bucket_size
         self.num_replicas = num_replicas
         self.parallel_rpc = parallel_rpc
@@ -177,6 +183,29 @@ class DHTNode:
 
     async def _rpc_store(self, peer: Endpoint, args: Dict[str, Any]) -> Dict[str, Any]:
         self._register_sender(peer, args)
+        if self.store_admission is not None:
+            # rate admission BEFORE validation: the point is bounding how
+            # much validator work one sender can demand. Identity = the
+            # claimed sender node id (self-chosen in open swarms, but the
+            # bucket table is LRU-bounded so identity churn buys rate, not
+            # memory), else the source host.
+            sid = args.get("sender_id")
+            identity = sid.hex() if isinstance(sid, bytes) else str(peer[0])
+            reason = self.store_admission.check(
+                identity, cost=float(len(args["records"]))
+            )
+            if reason is not None:
+                tele = telemetry.resolve(self.telemetry)
+                if tele is not None:
+                    tele.counter("serve.rejected").inc()
+                    tele.event(
+                        "serve.reject", reason=reason, rpc="dht.store",
+                        sender=identity[:32],
+                    )
+                return {
+                    "stored": [False] * len(args["records"]),
+                    "refused": reason,
+                }
         outcomes = []
         for rec in args["records"]:
             key, subkey, value, expiration = rec

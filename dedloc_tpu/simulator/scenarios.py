@@ -83,7 +83,7 @@ def record_fanout(swarm: SimSwarm, key: bytes) -> int:
 # (averaging fidelity, closed-loop adaptation, twin fitting, watchdog) is
 # measuring signals the eager join protocol itself produces.
 _WARM_BY_DEFAULT = frozenset(
-    {"dht_churn", "matchmaking", "catalog", "mixed", "diurnal"}
+    {"dht_churn", "matchmaking", "catalog", "mixed", "diurnal", "serving"}
 )
 
 
@@ -1975,6 +1975,311 @@ async def _scenario_diurnal(run: ScenarioRun) -> None:
     }
 
 
+def _zipf_weights(n: int, skew: float) -> List[float]:
+    raw = [1.0 / (i + 1) ** skew for i in range(n)]
+    total = sum(raw)
+    return [w / total for w in raw]
+
+
+def _weighted_index(rng: random.Random, weights: List[float]) -> int:
+    x = rng.random()
+    acc = 0.0
+    for i, w in enumerate(weights):
+        acc += w
+        if x < acc:
+            return i
+    return len(weights) - 1
+
+
+async def _scenario_serving(run: ScenarioRun) -> None:
+    """The swarm-as-serving-fleet rehearsal (ROADMAP item 1): expert hosts
+    announce signed ExpertRecords on the sim DHT wire, gateways route a
+    bursty scripted request trace latency/load-aware over SimNetwork
+    links, expert peers die mid-trace, and the ledger credits the serving
+    work. Spec section (all keys optional)::
+
+        scenario: serving
+        peers: 1000
+        experts: 16             # expert ids 0..E-1
+        hosts_per_expert: 3
+        gateways: 8
+        requests: 400           # scripted request trace length
+        burst: 8                # concurrent requests per burst
+        burst_gap_s: 0.25       # virtual gap between bursts
+        tokens: 16              # tokens per request
+        hidden: 8               # token feature width
+        skew: 1.1               # zipf exponent on expert popularity
+        capacity: 512           # per-host tokens-per-window bound
+        kill_hosts: 0           # expert hosts killed mid-trace
+        kill_at_frac: 0.5       # kill point, fraction of the trace
+        refresh_period_s: 2.0   # gateway discovery refresh
+        announce_period_s: 2.0  # host record refresh (TTL = 2x this)
+        deadline_s: 2.0         # per-request budget
+        hedge_after_s: 0.3
+        dispatch_rate: 0.0      # per-caller admission on hosts (0 = open)
+        ledger_slack: 1.25
+    """
+    import numpy as np
+
+    from dedloc_tpu.serving.admission import Admission
+    from dedloc_tpu.serving.host import ExpertHost
+    from dedloc_tpu.serving.router import ExpertRouter, RouterPolicy
+    from dedloc_tpu.telemetry.ledger import (
+        ContributionClaim,
+        fold_ledger,
+        leaderboard,
+        ledger_key,
+        parse_claims,
+    )
+
+    await phase_spawn(run)
+    spec = run.spec
+    prefix = str(spec.get("prefix", "simexp"))
+    E = int(spec.get("experts", 16))
+    H = int(spec.get("hosts_per_expert", 3))
+    G = int(spec.get("gateways", 8))
+    R = int(spec.get("requests", 400))
+    burst = max(1, int(spec.get("burst", 8)))
+    burst_gap = float(spec.get("burst_gap_s", 0.25))
+    tokens = int(spec.get("tokens", 16))
+    hidden = int(spec.get("hidden", 8))
+    skew = float(spec.get("skew", 1.1))
+    capacity = int(spec.get("capacity", 512))
+    kill_hosts = int(spec.get("kill_hosts", 0))
+    kill_at = int(R * float(spec.get("kill_at_frac", 0.5)))
+    refresh_s = float(spec.get("refresh_period_s", 2.0))
+    announce_s = float(spec.get("announce_period_s", 2.0))
+    version = int(spec.get("version", 100))
+
+    peers = run.swarm.alive_peers()
+    if len(peers) < E * H + G:
+        raise ValueError(
+            f"serving scenario needs >= {E * H + G} peers, have {len(peers)}"
+        )
+    host_peers = peers[: E * H]
+    gateway_peers = peers[E * H : E * H + G]
+
+    # --- expert hosts: host i serves expert i % E (H replicas per expert)
+    def _compute(expert_id: int, x):
+        # deterministic synthetic expert: distinct affine map per expert,
+        # so a reply proves WHICH expert computed it
+        return (x * np.float32(1.0 + expert_id) + np.float32(expert_id))
+
+    dispatch_rate = float(spec.get("dispatch_rate", 0.0))
+    hosts: List = []
+    for i, peer in enumerate(host_peers):
+        admission = (
+            Admission(rate=dispatch_rate, burst=dispatch_rate * 2.0)
+            if dispatch_rate > 0 else None
+        )
+        hosts.append(ExpertHost(
+            peer.node, prefix, [i % E], version,
+            compute_fn=_compute, capacity=capacity, admission=admission,
+            telemetry_registry=peer.telemetry,
+        ))
+    last_announce = [None] * len(hosts)
+
+    async def announce_due() -> None:
+        """Drive host record refreshes from the trace loop (no background
+        tasks — deterministic, and a killed host simply stops refreshing
+        so its record ages out within one TTL)."""
+        now = get_dht_time()
+        due = [
+            k for k, peer in enumerate(host_peers)
+            if peer.alive and (
+                last_announce[k] is None
+                or now - last_announce[k] >= announce_s
+            )
+        ]
+        await asyncio.gather(
+            *(hosts[k].announce(expiration=announce_s * 2.0) for k in due)
+        )
+        for k in due:
+            last_announce[k] = now
+
+    await announce_due()
+
+    # --- gateways
+    policy = RouterPolicy(
+        deadline_s=float(spec.get("deadline_s", 2.0)),
+        attempt_timeout_s=float(spec.get("attempt_timeout_s", 0.6)),
+        retries=int(spec.get("retries", 2)),
+        backoff_s=float(spec.get("backoff_s", 0.05)),
+        hedge_after_s=float(spec.get("hedge_after_s", 0.3)),
+        refresh_period_s=refresh_s,
+    )
+    routers = [
+        ExpertRouter(
+            peer.node, prefix, policy=policy,
+            telemetry_registry=peer.telemetry, caller=peer.label,
+        )
+        for peer in gateway_peers
+    ]
+    for router in routers:
+        await router.refresh(force=True)
+
+    # --- the scripted bursty trace, fully precomputed (determinism)
+    zipf = _zipf_weights(E, skew)
+    trace = [
+        (i, i % G, _weighted_index(run.rng, zipf)) for i in range(R)
+    ]
+    base_tokens = np.arange(tokens * hidden, dtype=np.float32).reshape(
+        tokens, hidden
+    ) / np.float32(tokens * hidden)
+
+    killed_labels: List[str] = []
+    killed_experts: List[int] = []
+    kill_t: Optional[float] = None
+    results: List[Dict[str, Any]] = []
+    wedged = 0
+    health_state: Dict[str, Any] = {}
+    health_folds: List[Dict[str, Any]] = []
+    fold_every = max(1, R // max(1, int(spec.get("health_folds", 3))))
+
+    async def one_request(i: int, gw: int, expert: int) -> Dict[str, Any]:
+        t0 = get_dht_time()
+        x = base_tokens + np.float32(i % 7)
+        y = await routers[gw].dispatch(expert, x, f"req-{i:04d}")
+        ok = y is not None
+        if ok:
+            # the affine map proves the right expert answered
+            expected = _compute(expert, x)
+            if not np.allclose(y, expected, rtol=1e-5, atol=1e-5):
+                raise AssertionError(
+                    f"request {i}: expert {expert} returned wrong payload"
+                )
+        return {
+            "i": i, "gateway": gw, "expert": expert, "ok": ok,
+            "t0": round(t0 - SIM_EPOCH, 6),
+            "dur_s": round(get_dht_time() - t0, 6),
+        }
+
+    for b0 in range(0, R, burst):
+        if kill_hosts > 0 and kill_t is None and b0 >= kill_at:
+            victims = host_peers[:kill_hosts]
+            kill_t = get_dht_time()
+            for victim in victims:
+                killed_labels.append(victim.label)
+                await run.swarm.kill(victim)
+            killed_experts = sorted(
+                {i % E for i in range(kill_hosts)}
+            )
+        await announce_due()
+        batch = trace[b0 : b0 + burst]
+        outs = await asyncio.gather(
+            *(one_request(*req) for req in batch), return_exceptions=True
+        )
+        for out in outs:
+            if isinstance(out, AssertionError):
+                raise out
+            if isinstance(out, BaseException):
+                wedged += 1  # a request neither served nor fell through
+            else:
+                results.append(out)
+        if (b0 // burst) % max(1, fold_every // burst) == 0:
+            health_folds.append(
+                fold_swarm_health(run.swarm, b0 // burst, health_state)
+            )
+        await asyncio.sleep(burst_gap)
+
+    health_folds.append(
+        fold_swarm_health(run.swarm, R // burst, health_state)
+    )
+
+    # --- the serving ledger: hosts claim their served bytes/requests and
+    # the coordinator-shaped fold credits them on the leaderboard
+    slack = float(spec.get("ledger_slack", 1.25))
+    t_claim = get_dht_time()
+    for k, peer in enumerate(host_peers):
+        if not peer.alive:
+            continue
+        host = hosts[k]
+        claim = ContributionClaim(
+            peer=peer.node.node_id.to_bytes().hex(),
+            samples=0, rounds=0, train_seconds=0.0,
+            bytes_served=int(host.bytes_served),
+            requests_served=int(host.requests_served),
+            time=t_claim,
+        )
+        peer.telemetry.counter("ledger.claims").inc()
+        peer.telemetry.event(
+            "ledger.claim", peer=claim.peer, samples=0, rounds=0,
+            train_seconds=0.0, bytes_served=claim.bytes_served,
+            requests_served=claim.requests_served,
+        )
+        await peer.node.store(
+            ledger_key(prefix).encode(), claim.model_dump(),
+            get_dht_time() + 3600.0,
+            subkey=peer.node.node_id.to_bytes(),
+        )
+    reader = gateway_peers[0]
+    centry = await reader.node.get(ledger_key(prefix).encode(), latest=True)
+    citems = (
+        [(sk, v.value) for sk, v in centry.value.items()]
+        if centry is not None and hasattr(centry.value, "items")
+        else []
+    )
+    folded = fold_ledger(
+        None, parse_claims(citems), [], slack=slack, now=get_dht_time()
+    )
+    run.report["ledger_rows"] = [
+        {"t": folded["t"], "step": 0, "ledger": folded}
+    ]
+    run.report["ledger"] = folded
+    run.report["leaderboard"] = leaderboard(folded)
+    run.report["health_folds"] = health_folds
+
+    # --- the sizing report
+    durs_ok = [r["dur_s"] for r in results if r["ok"]]
+    fall = [r for r in results if not r["ok"]]
+    by_expert: Dict[int, int] = {}
+    for r in results:
+        by_expert[r["expert"]] = by_expert.get(r["expert"], 0) + 1
+    loads = [by_expert.get(e, 0) for e in range(E)]
+    mean_load = sum(loads) / max(1, len(loads))
+    # fall-through AFTER the re-route bound: a request that STARTED one
+    # full discovery refresh past the kill, on an expert that still has a
+    # live replica, must be servable — this is the scenario's re-route
+    # assertion surface
+    fall_post_refresh = 0
+    if kill_t is not None:
+        rel_kill = kill_t - SIM_EPOCH
+        survivors = {
+            i % E for i in range(kill_hosts, E * H)
+        }
+        fall_post_refresh = sum(
+            1 for r in fall
+            if r["t0"] > rel_kill + refresh_s + announce_s * 2.0
+            and r["expert"] in survivors
+        )
+    run.report["serving"] = {
+        "experts": E,
+        "hosts": len(host_peers),
+        "gateways": G,
+        "requests": R,
+        "completed": len(results),
+        "wedged": wedged,
+        "served": len(durs_ok),
+        "fall_through": len(fall),
+        "fall_through_rate": round(len(fall) / max(1, R), 4),
+        "fall_through_post_refresh": fall_post_refresh,
+        "latency_p50_s": round(percentile(durs_ok, 0.50), 4),
+        "latency_p99_s": round(percentile(durs_ok, 0.99), 4),
+        "load_by_expert": loads,
+        "load_skew": round(max(loads) / mean_load, 3) if mean_load else 0.0,
+        "killed": killed_labels,
+        "killed_experts": killed_experts,
+        "kill_t": (
+            round(kill_t - SIM_EPOCH, 3) if kill_t is not None else None
+        ),
+        "rejected": int(run.swarm.counters_total("serve.rejected")),
+        "rerouted": int(run.swarm.counters_total("serve.rerouted")),
+        "retries": int(run.swarm.counters_total("serve.retries")),
+        "hedges": int(run.swarm.counters_total("serve.hedges")),
+        "refreshes": int(run.swarm.counters_total("serve.refreshes")),
+    }
+
+
 SCENARIOS: Dict[str, Callable] = {
     "dht_churn": _scenario_dht_churn,
     "matchmaking": _scenario_matchmaking,
@@ -1986,6 +2291,7 @@ SCENARIOS: Dict[str, Callable] = {
     "closed_loop": _scenario_closed_loop,
     "ledger": _scenario_ledger,
     "diurnal": _scenario_diurnal,
+    "serving": _scenario_serving,
     # resolved specially by run_scenario: replays a fitted TwinModel
     # (dedloc_tpu/twin) instead of building a swarm from spec numbers
     "twin_replay": None,

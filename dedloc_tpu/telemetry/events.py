@@ -48,6 +48,12 @@ CKPT_SHARDS_FETCHED = "ckpt.shards_fetched"
 CKPT_SHARDS_RESUMED = "ckpt.shards_resumed"
 CKPT_SHARDS_SERVED = "ckpt.shards_served"
 CKPT_VERIFY_FAILURES = "ckpt.verify_failures"
+EXPERT_ANNOUNCES = "expert.announces"
+EXPERT_BYTES_SERVED = "expert.bytes_served"
+EXPERT_COMPUTE = "expert.compute"
+EXPERT_LOAD_EWMA = "expert.load_ewma"
+EXPERT_REQUESTS = "expert.requests"
+EXPERT_TOKENS = "expert.tokens"
 FAULT_APPLIED = "fault.applied"
 FAULT_INJECTED = "fault.injected"
 FAULTS_APPLIED = "faults.applied"
@@ -106,6 +112,20 @@ RPC_CONNS_LOST = "rpc.conns_lost"
 RPC_SERVER_ERRORS = "rpc.server.errors"
 RPC_SERVER_REQUESTS = "rpc.server.requests"
 RUN_CONFIG = "run.config"
+SERVE_FALL_THROUGH = "serve.fall_through"
+SERVE_HEDGES = "serve.hedges"
+SERVE_HOST_FAILURE = "serve.host_failure"
+SERVE_KNOWN_EXPERTS = "serve.known_experts"
+SERVE_OK = "serve.ok"
+SERVE_REFRESHES = "serve.refreshes"
+SERVE_REJECT = "serve.reject"
+SERVE_REJECTED = "serve.rejected"
+SERVE_REQUEST = "serve.request"
+SERVE_REQUESTS = "serve.requests"
+SERVE_REROUTE = "serve.reroute"
+SERVE_REROUTED = "serve.rerouted"
+SERVE_RETRIES = "serve.retries"
+SERVE_TOKENS = "serve.tokens"
 STATE_SERVE = "state.serve"
 STATE_SERVED = "state.served"
 STATE_SERVED_BYTES = "state.served_bytes"
@@ -154,6 +174,10 @@ COUNTERS = frozenset({
     "ckpt.shards_resumed",
     "ckpt.shards_served",
     "ckpt.verify_failures",
+    "expert.announces",
+    "expert.bytes_served",
+    "expert.requests",
+    "expert.tokens",
     "faults.applied",
     "faults.injected",
     "ledger.claims",
@@ -187,6 +211,15 @@ COUNTERS = frozenset({
     "rpc.conns_lost",
     "rpc.server.errors",
     "rpc.server.requests",
+    "serve.fall_through",
+    "serve.hedges",
+    "serve.ok",
+    "serve.refreshes",
+    "serve.rejected",
+    "serve.requests",
+    "serve.rerouted",
+    "serve.retries",
+    "serve.tokens",
     "state.served",
     "state.served_bytes",
     "state_sync.attempts",
@@ -198,9 +231,11 @@ COUNTERS = frozenset({
     "watch.rollbacks",
 })
 GAUGES = frozenset({
+    "expert.load_ewma",
     "opt.ef_residual_norm",
     "opt.overlap_efficiency",
     "opt.weight_scale",
+    "serve.known_experts",
     "step.mfu",
     "step.samples_per_sec",
 })
@@ -212,9 +247,11 @@ HISTOGRAMS = frozenset({
     "ckpt.provider_goodput",
     "ckpt.restore",
     "ckpt.shard.serve",
+    "expert.compute",
     "mm.form_group",
     "mm.join.serve",
     "opt.d2h_wait_s",
+    "serve.request",
     "state.serve",
     "step.phase.avg_wire",
     "step.phase.fwd_bwd",
@@ -235,6 +272,7 @@ EVENTS = frozenset({
     "ckpt.shard.serve",
     "ckpt.shard_fetch_failed",
     "ckpt.shard_verify_failure",
+    "expert.compute",
     "fault.applied",
     "fault.injected",
     "ledger.claim",
@@ -260,6 +298,11 @@ EVENTS = frozenset({
     "rpc.client.failure",
     "rpc.conn_lost",
     "run.config",
+    "serve.fall_through",
+    "serve.host_failure",
+    "serve.reject",
+    "serve.request",
+    "serve.reroute",
     "state.serve",
     "state_sync.checksum_failure",
     "state_sync.failed",
@@ -278,8 +321,10 @@ SPANS = frozenset({
     "ckpt.manifest.serve",
     "ckpt.restore",
     "ckpt.shard.serve",
+    "expert.compute",
     "mm.form_group",
     "mm.join.serve",
+    "serve.request",
     "state.serve",
 })
 EMITTED = COUNTERS | GAUGES | HISTOGRAMS | EVENTS

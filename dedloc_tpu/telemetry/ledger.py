@@ -104,13 +104,20 @@ class ContributionClaim(BaseModel):
     rounds: StrictInt  # cumulative averaging rounds completed
     train_seconds: float  # wall-seconds since the optimizer came up
     bytes_served: StrictInt  # ckpt.shard_bytes_served + state.served_bytes
+    # inference requests served from the expert-serving plane
+    # (serving/host.py) — optional with a 0 default so pre-serving claim
+    # records keep parsing unchanged
+    requests_served: StrictInt = 0
     time: float  # publication stamp (DHT clock)
 
     @model_validator(mode="after")
     def _check(self) -> "ContributionClaim":
         if not self.peer or len(self.peer) > 128:
             raise ValueError(f"bad peer id {self.peer!r}")
-        if self.samples < 0 or self.rounds < 0 or self.bytes_served < 0:
+        if (
+            self.samples < 0 or self.rounds < 0
+            or self.bytes_served < 0 or self.requests_served < 0
+        ):
             raise ValueError("claim totals must be non-negative")
         if not _finite(self.train_seconds) or self.train_seconds < 0:
             raise ValueError(f"bad train_seconds {self.train_seconds!r}")
@@ -404,6 +411,7 @@ def fold_ledger(prev: Optional[Dict[str, Any]],
             "claimed_rounds": int(claim.rounds),
             "train_seconds": round(float(claim.train_seconds), 3),
             "bytes_served": int(claim.bytes_served),
+            "requests_served": int(claim.requests_served),
             "last_claim_t": round(float(claim.time), 3),
             "discrepancy": None,
         }
@@ -468,6 +476,7 @@ def fold_ledger(prev: Optional[Dict[str, Any]],
             "claimed_rounds": 0,
             "train_seconds": 0.0,
             "bytes_served": 0,
+            "requests_served": 0,
             "last_claim_t": None,
             "coverage": "receipts-only",
             "supported_samples": round(eff_s, 3),
@@ -513,6 +522,7 @@ def leaderboard(ledger: Dict[str, Any]) -> List[Dict[str, Any]]:
         key=lambda e: (
             -int(e.get("credited_samples") or 0),
             -int(e.get("bytes_served") or 0),
+            -int(e.get("requests_served") or 0),
             str(e.get("peer")),
         ),
     ):
@@ -523,6 +533,7 @@ def leaderboard(ledger: Dict[str, Any]) -> List[Dict[str, Any]]:
             "claimed_samples": int(e.get("claimed_samples") or 0),
             "credited_rounds": int(e.get("credited_rounds") or 0),
             "bytes_served": int(e.get("bytes_served") or 0),
+            "requests_served": int(e.get("requests_served") or 0),
             "share": round(credited / total, 4) if total > 0 else 0.0,
             "coverage": e.get("coverage"),
             "discrepancy": e.get("discrepancy"),

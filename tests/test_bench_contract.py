@@ -94,6 +94,33 @@ def test_bench_sim_engine_mode_contract():
     assert "diurnal_10k" not in record  # the timing half was skipped
 
 
+def test_bench_serving_mode_contract():
+    """Serving-plane bench smoke (DEDLOC_BENCH=serving): the tiny fleet
+    runs the serving scenario end-to-end and prints one JSON line with the
+    gate-facing keys. The metric name carries the roster size, so this
+    40-peer smoke never gates against a full 1,000-peer round."""
+    env = dict(os.environ, DEDLOC_BENCH="serving",
+               DEDLOC_BENCH_TINY="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py")],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    json_lines = [
+        l for l in out.stdout.strip().splitlines() if l.startswith("{")
+    ]
+    assert len(json_lines) == 1, out.stdout
+    record = json.loads(json_lines[0])
+    assert record["metric"] == "serving40_requests_per_wall_sec"
+    assert record["unit"] == "requests/sec"
+    assert record["value"] > 0 and record["wall_s"] > 0
+    assert record["wedged"] == 0
+    assert record["served"] + record["requests"] * record[
+        "fall_through_rate"] == pytest.approx(record["requests"], abs=1)
+    assert record["latency_p99_s"] >= record["latency_p50_s"]
+
+
 def _run_pipeline_bench(timing=True):
     env = dict(os.environ, DEDLOC_BENCH="allreduce_pipeline",
                DEDLOC_BENCH_TINY="1", JAX_PLATFORMS="cpu",

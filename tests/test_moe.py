@@ -86,6 +86,111 @@ def test_moe_expert_sharded_matches_local(rng):
     assert float(aux_sh) == pytest.approx(float(aux_local), rel=1e-5)
 
 
+def test_moe_capacity_overflow_fall_through_is_exact(rng):
+    """ISSUE 20 satellite: WHICH tokens fall through is part of the Switch
+    contract — first-come within an expert's queue, in token order. With
+    every token forced onto expert 0 at capacity C, exactly tokens [0, C)
+    are computed (matching the per-token reference bit for bit at f32
+    tolerance) and tokens [C, T) are exactly zero."""
+    cfg = MoEConfig(
+        hidden_size=4, ffn_size=8, num_experts=2, capacity_factor=1.0
+    )
+    params = init_moe_params(cfg, jax.random.PRNGKey(5))
+    params["router"] = jnp.zeros_like(params["router"]).at[:, 0].set(10.0)
+    T = 6  # capacity = ceil(6 / 2 * 1.0) = 3 on expert 0
+    capacity = max(1, math.ceil(T / cfg.num_experts * cfg.capacity_factor))
+    # strictly positive tokens: the forced logit is 10 * sum(x_row), so a
+    # negative row sum would silently unforce the routing
+    x = jnp.asarray(
+        np.abs(rng.normal(0, 1, (T, cfg.hidden_size))) + 0.1, jnp.float32
+    )
+    y, _ = jax.jit(lambda p, v: moe_ffn(p, v, cfg))(params, x)
+    ref = _reference(params, x, cfg)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+    assert np.all(np.any(ref[:capacity] != 0, axis=-1)), (
+        "in-capacity tokens must be computed"
+    )
+    np.testing.assert_array_equal(np.asarray(y[capacity:]), 0)
+
+
+def test_moe_zero_token_expert_contributes_nothing(rng):
+    """An expert that receives zero tokens must neither corrupt outputs
+    nor poison gradients: zeroing its weights changes nothing, and its
+    wi/wo gradient through the dispatched path is exactly zero (only the
+    router sees it, via the softmax)."""
+    cfg = MoEConfig(
+        hidden_size=4, ffn_size=8, num_experts=4, capacity_factor=2.0
+    )
+    params = init_moe_params(cfg, jax.random.PRNGKey(6))
+    # route everything to expert 1: experts 0, 2, 3 get zero tokens
+    # (positive tokens keep the forced logit 10 * sum(x_row) positive)
+    params["router"] = jnp.zeros_like(params["router"]).at[:, 1].set(10.0)
+    x = jnp.asarray(
+        np.abs(rng.normal(0, 1, (8, cfg.hidden_size))) + 0.1, jnp.float32
+    )
+    y, aux = moe_ffn(params, x, cfg)
+    np.testing.assert_allclose(
+        np.asarray(y), _reference(params, x, cfg), rtol=1e-4, atol=1e-5
+    )
+    assert np.isfinite(float(aux))
+    starved = dict(params)
+    starved["wi"] = params["wi"].at[0].set(0.0).at[2].set(0.0).at[3].set(0.0)
+    y2, _ = moe_ffn(starved, x, cfg)
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(y), atol=1e-6)
+    g = jax.grad(lambda p: jnp.mean(moe_ffn(p, x, cfg)[0] ** 2))(params)
+    for e in (0, 2, 3):
+        np.testing.assert_array_equal(np.asarray(g["wi"][e]), 0.0)
+        np.testing.assert_array_equal(np.asarray(g["wo"][e]), 0.0)
+
+
+def test_moe_static_shapes_under_jit_for_uneven_token_counts(rng):
+    """Uneven per-expert token counts are a DATA property, not a SHAPE
+    property: one jit trace must serve a balanced batch, a fully-skewed
+    batch, and a starved-expert batch without recompiling (the static
+    [T, E, C] dispatch is the whole point of the Switch formulation)."""
+    cfg = MoEConfig(
+        hidden_size=4, ffn_size=8, num_experts=4, capacity_factor=1.0
+    )
+    params = init_moe_params(cfg, jax.random.PRNGKey(7))
+    T = 13  # non-divisible by E: capacity = ceil(13/4) = 4
+    f = jax.jit(lambda p, v: moe_ffn(p, v, cfg))
+
+    batches = [
+        jnp.asarray(rng.normal(0, 1, (T, cfg.hidden_size)), jnp.float32),
+        jnp.full((T, cfg.hidden_size), 3.0, jnp.float32),  # all one expert
+        jnp.asarray(rng.normal(0, 5, (T, cfg.hidden_size)), jnp.float32),
+    ]
+    y0, _ = f(params, batches[0])
+    traces_after_first = f._cache_size()
+    for x in batches:
+        y, aux = f(params, x)
+        assert y.shape == (T, cfg.hidden_size) and aux.shape == ()
+        np.testing.assert_allclose(
+            np.asarray(y), _reference(params, x, cfg), rtol=1e-4, atol=1e-5
+        )
+    assert f._cache_size() == traces_after_first, (
+        "routing skew must not trigger a retrace"
+    )
+
+
+def test_moe_aux_loss_matches_hand_computed_batch():
+    """The Switch aux loss on a batch small enough to do on paper: H=2,
+    E=2, router diag(2), tokens = 3x[1,0] + 1x[0,1]. Gates per token are
+    softmax([2, 0]) = [q, 1-q] with q = e^2/(e^2+1); density = [3/4, 1/4];
+    proxy = [(3q + (1-q))/4, ((1-q)*3 + q)/4]; loss = 2 * density·proxy."""
+    cfg = MoEConfig(hidden_size=2, ffn_size=4, num_experts=2)
+    params = init_moe_params(cfg, jax.random.PRNGKey(8))
+    params["router"] = jnp.asarray([[2.0, 0.0], [0.0, 2.0]], jnp.float32)
+    x = jnp.asarray(
+        [[1.0, 0.0], [1.0, 0.0], [1.0, 0.0], [0.0, 1.0]], jnp.float32
+    )
+    _, aux = moe_ffn(params, x, cfg)
+    q = math.exp(2.0) / (math.exp(2.0) + 1.0)
+    proxy = [(3 * q + (1 - q)) / 4, (3 * (1 - q) + q) / 4]
+    expected = 2.0 * (0.75 * proxy[0] + 0.25 * proxy[1])
+    assert float(aux) == pytest.approx(expected, rel=1e-5)
+
+
 def test_moe_gradients_flow_everywhere(rng):
     params = init_moe_params(CFG, jax.random.PRNGKey(4))
     x = jnp.asarray(rng.normal(0, 1, (12, CFG.hidden_size)), jnp.float32)

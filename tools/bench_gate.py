@@ -68,6 +68,11 @@ MULTICHIP_BASELINE_GLOB = os.path.join(REPO_ROOT, "MULTICHIP_r*.json")
 # wall variance is ~±15%, so gate sim metrics with --tolerance 0.15
 # (SIMBENCH_r01.json note) rather than the TPU default.
 SIMBENCH_BASELINE_GLOB = os.path.join(REPO_ROOT, "SIMBENCH_r*.json")
+# the serving-plane trajectory (DEDLOC_BENCH=serving): requests resolved
+# per wall second through the 1,000-peer serving scenario. Same driver
+# layout, same single-core wall-variance caveat as SIMBENCH — gate with
+# --tolerance 0.15.
+SERVEBENCH_BASELINE_GLOB = os.path.join(REPO_ROOT, "SERVEBENCH_r*.json")
 
 # "[2026-08-01 21:43:54.504][INFO][dedloc_tpu.collaborative.optimizer]
 #  global step 189 applied (group=1, samples~48)"
@@ -240,7 +245,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "baselines", nargs="*",
         help=f"baseline bench JSONs (default: {DEFAULT_BASELINE_GLOB} "
-             f"+ {MULTICHIP_BASELINE_GLOB} + {SIMBENCH_BASELINE_GLOB})",
+             f"+ {MULTICHIP_BASELINE_GLOB} + {SIMBENCH_BASELINE_GLOB} "
+             f"+ {SERVEBENCH_BASELINE_GLOB})",
     )
     parser.add_argument(
         "--tolerance", type=float, default=0.03,
@@ -259,6 +265,7 @@ def main(argv=None) -> int:
         glob.glob(DEFAULT_BASELINE_GLOB)
         + glob.glob(MULTICHIP_BASELINE_GLOB)
         + glob.glob(SIMBENCH_BASELINE_GLOB)
+        + glob.glob(SERVEBENCH_BASELINE_GLOB)
     )
     baselines = [r for r in (load_bench(p) for p in paths) if r is not None]
     text, code = gate(fresh, baselines, tolerance=args.tolerance)

@@ -39,6 +39,7 @@ SIM_REACHABLE = (
     "dedloc_tpu/simulator/",
     "dedloc_tpu/telemetry/",
     "dedloc_tpu/checkpointing/",
+    "dedloc_tpu/serving/",
 )
 
 _WALL = {

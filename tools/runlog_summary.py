@@ -1410,6 +1410,7 @@ def contributions_data(all_rows):
                     "rounds": r.get("rounds"),
                     "train_seconds": r.get("train_seconds"),
                     "bytes_served": r.get("bytes_served"),
+                    "requests_served": r.get("requests_served") or 0,
                     "time": float(r.get("t", 0.0)),
                 }))
             except Exception:  # noqa: BLE001 — malformed event row
@@ -1491,7 +1492,7 @@ def print_contributions(all_rows):
     )
     print(
         f"{'#':>3} {'peer':<14} {'credited':>10} {'claimed':>10} "
-        f"{'share':>6} {'rounds':>6} {'served':>9}  coverage"
+        f"{'share':>6} {'rounds':>6} {'served':>9} {'reqs':>6}  coverage"
     )
     for i, e in enumerate(doc["leaderboard"], 1):
         peer = str(e.get("peer") or "?")
@@ -1506,7 +1507,8 @@ def print_contributions(all_rows):
             f"{i:>3} {short:<14} {e['credited_samples']:>10} "
             f"{e['claimed_samples']:>10} "
             f"{e['share'] * 100:>5.1f}% {e['credited_rounds']:>6} "
-            f"{_fmt_bytes_served(e['bytes_served']):>9}  "
+            f"{_fmt_bytes_served(e['bytes_served']):>9} "
+            f"{e.get('requests_served') or 0:>6}  "
             f"{e.get('coverage') or '?'}{flag}"
         )
     if doc["discrepancies"]:
